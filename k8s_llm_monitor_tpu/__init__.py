@@ -1,0 +1,27 @@
+"""k8s_llm_monitor_tpu — a TPU-native Kubernetes intelligent-monitoring framework.
+
+A from-scratch rebuild of the capability set of Sabre94/k8s-llm-monitor
+(reference mounted read-only at /root/reference), designed TPU-first:
+
+- ``monitor/``  — the Kubernetes control plane: cluster client (+ fake in-memory
+  backend), watch machinery, metrics manager and sources, network analyzer with
+  RTT probing, CRD-driven battery-aware scheduler, UAV telemetry stack, and the
+  HTTP API + web dashboard.  Capability parity with the reference's Go code
+  (see SURVEY.md §2), re-derived in Python.
+- ``models/``   — Llama-3 / Qwen2-family decoder LMs and a BGE-style embedding
+  encoder, written as pure-functional JAX (pytree params, jit-compiled steps).
+- ``ops/``      — TPU compute primitives: RoPE, RMSNorm, fused attention with a
+  paged KV cache (Pallas kernel + XLA fallback), sampling.
+- ``parallel/`` — device mesh construction and GSPMD sharding rules
+  (DP/TP/SP/PP) for serving and training over ICI/DCN.
+- ``serving/``  — the inference engine: paged KV-cache allocator, continuous
+  batching scheduler, streaming generation API.
+- ``training/`` — sharded train step (loss, grad, optax update) for
+  fine-tuning the analysis models.
+- ``analysis/`` — the Analysis Engine the reference only sketched
+  (internal/config/config.go:141-145 is its entire LLM integration): prompt
+  assembly from cluster evidence, root-cause / pod-communication / anomaly
+  analyzers, and the /api/v1/query NL endpoint backed by the local TPU engine.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,11 @@
+"""Model family: Llama-3/Qwen2 decoder LMs + BGE-style embedding encoder."""
+
+from k8s_llm_monitor_tpu.models.config import (
+    ENCODER_PRESETS,
+    PRESETS,
+    EncoderConfig,
+    ModelConfig,
+)
+from k8s_llm_monitor_tpu.models import llama
+
+__all__ = ["ModelConfig", "EncoderConfig", "PRESETS", "ENCODER_PRESETS", "llama"]

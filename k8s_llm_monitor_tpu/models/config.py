@@ -1,0 +1,149 @@
+"""Model configurations for the decoder LM family and embedding encoders.
+
+The flagship serving targets come from BASELINE.md's benchmark matrix:
+Llama-3-8B (v5e-1 / v5e-8), Llama-3-70B / Qwen2-72B (v5p-16), and a
+BGE-large-class encoder for the anomaly detector's embedding path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelConfig:
+    """Architecture hyperparameters for a Llama/Qwen2-family decoder LM.
+
+    The family covers:
+      - Llama-3:  GQA, RoPE (high theta), SwiGLU MLP, RMSNorm, no biases.
+      - Qwen2:    same skeleton + QKV projection biases.
+    """
+
+    name: str = "tiny"
+    vocab_size: int = 256
+    hidden_size: int = 64
+    intermediate_size: int = 128
+    num_layers: int = 2
+    num_heads: int = 4
+    num_kv_heads: int = 2
+    head_dim: Optional[int] = None  # defaults to hidden_size // num_heads
+    rope_theta: float = 500_000.0
+    rms_norm_eps: float = 1e-5
+    max_seq_len: int = 8192
+    qkv_bias: bool = False          # True for Qwen2
+    tie_embeddings: bool = False
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim if self.head_dim is not None else self.hidden_size // self.num_heads
+
+    @property
+    def q_per_kv(self) -> int:
+        return self.num_heads // self.num_kv_heads
+
+
+# ---------------------------------------------------------------------------
+# Presets.  Shapes follow the public architecture cards for each model family.
+# ---------------------------------------------------------------------------
+
+TINY = ModelConfig(name="tiny")
+
+TINY_QWEN = ModelConfig(name="tiny-qwen", qkv_bias=True)
+
+LLAMA3_8B = ModelConfig(
+    name="llama3-8b",
+    vocab_size=128_256,
+    hidden_size=4096,
+    intermediate_size=14_336,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    rope_theta=500_000.0,
+    max_seq_len=8192,
+)
+
+LLAMA3_70B = ModelConfig(
+    name="llama3-70b",
+    vocab_size=128_256,
+    hidden_size=8192,
+    intermediate_size=28_672,
+    num_layers=80,
+    num_heads=64,
+    num_kv_heads=8,
+    rope_theta=500_000.0,
+    max_seq_len=8192,
+)
+
+QWEN2_72B = ModelConfig(
+    name="qwen2-72b",
+    vocab_size=152_064,
+    hidden_size=8192,
+    intermediate_size=29_568,
+    num_layers=80,
+    num_heads=64,
+    num_kv_heads=8,
+    rope_theta=1_000_000.0,
+    max_seq_len=32_768,
+    qkv_bias=True,
+)
+
+# A ~1.1B config used for single-chip benchmarks when full 8B weights would not
+# leave headroom for the KV cache on a 16 GB v5e chip with random-init weights.
+LLAMA_1B = ModelConfig(
+    name="llama-1b",
+    vocab_size=128_256,
+    hidden_size=2048,
+    intermediate_size=8192,
+    num_layers=16,
+    num_heads=32,
+    num_kv_heads=8,
+    head_dim=64,
+    rope_theta=500_000.0,
+    max_seq_len=8192,
+)
+
+PRESETS = {
+    c.name: c
+    for c in [TINY, TINY_QWEN, LLAMA3_8B, LLAMA3_70B, QWEN2_72B, LLAMA_1B]
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class EncoderConfig:
+    """BERT-family bidirectional encoder (BGE-large) for embeddings.
+
+    Used by the anomaly detector (analysis/anomaly.py) to embed log lines and
+    cluster events; BASELINE.md config #3.
+    """
+
+    name: str = "tiny-encoder"
+    vocab_size: int = 512
+    hidden_size: int = 64
+    intermediate_size: int = 128
+    num_layers: int = 2
+    num_heads: int = 4
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    layer_norm_eps: float = 1e-12
+    dtype: str = "float32"
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+
+TINY_ENCODER = EncoderConfig()
+
+BGE_LARGE = EncoderConfig(
+    name="bge-large",
+    vocab_size=30_522,
+    hidden_size=1024,
+    intermediate_size=4096,
+    num_layers=24,
+    num_heads=16,
+    max_position_embeddings=512,
+)
+
+ENCODER_PRESETS = {c.name: c for c in [TINY_ENCODER, BGE_LARGE]}

@@ -1,0 +1,314 @@
+"""Llama-3 / Qwen2-family decoder LM, pure-functional JAX.
+
+Design notes (TPU-first, not a port):
+  - Params are a plain pytree (nested dicts + per-layer list).  Linear kernels
+    are stored ``[in_features, out_features]`` so the forward pass is a single
+    ``x @ W`` that XLA tiles onto the MXU; HF checkpoints are transposed once
+    at load time (utils/checkpoint.py).
+  - Three entry points, all shape-static and jittable:
+      * ``forward_full``  — dense causal forward (training / logit parity).
+      * ``prefill``       — padded-batch prompt ingestion that scatters K/V
+                            into a paged block cache and returns last-token
+                            logits.
+      * ``decode_step``   — one-token step over the paged cache.
+  - The paged KV cache is a pytree of per-layer page arrays
+    ``[num_blocks, block_size, kv_heads, head_dim]``.  Block id 0 is reserved
+    as the null block: masked/inactive lanes scatter their writes there, which
+    keeps every write shape-static without corrupting live sequences
+    (serving/kv_cache.py never allocates block 0).
+
+Capability context: this model is the Analysis Engine backend the reference
+only configured but never implemented (reference internal/config/config.go:
+141-145 holds the entire LLM integration; README.md:89-95 documents the
+/api/v1/query endpoint that cmd/server/main.go never registers).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from k8s_llm_monitor_tpu.models.config import ModelConfig
+from k8s_llm_monitor_tpu.ops.attention import (
+    causal_attention,
+    paged_decode_attention,
+)
+from k8s_llm_monitor_tpu.ops.norms import rms_norm
+from k8s_llm_monitor_tpu.ops.rope import apply_rope, rope_angles
+
+Params = dict[str, Any]
+
+
+class KVPages(NamedTuple):
+    """Paged KV cache: per-layer lists of page arrays.
+
+    k[i], v[i]: [num_blocks, block_size, kv_heads, head_dim]
+    """
+
+    k: list[jnp.ndarray]
+    v: list[jnp.ndarray]
+
+    @property
+    def num_blocks(self) -> int:
+        return self.k[0].shape[0]
+
+    @property
+    def block_size(self) -> int:
+        return self.k[0].shape[1]
+
+
+def init_kv_pages(cfg: ModelConfig, num_blocks: int, block_size: int) -> KVPages:
+    dtype = jnp.dtype(cfg.dtype)
+    shape = (num_blocks, block_size, cfg.num_kv_heads, cfg.head_dim_)
+    return KVPages(
+        k=[jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)],
+        v=[jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)],
+    )
+
+
+# ---------------------------------------------------------------------------
+# Init
+# ---------------------------------------------------------------------------
+
+
+def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
+    """Random-init parameters (truncated-normal-ish scaled normals)."""
+    dtype = jnp.dtype(cfg.dtype)
+    H, D = cfg.hidden_size, cfg.head_dim_
+    nH, nKV, I = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size
+
+    def dense(key, in_f, out_f, bias):
+        w = jax.random.normal(key, (in_f, out_f), jnp.float32) * (in_f ** -0.5)
+        p = {"kernel": w.astype(dtype)}
+        if bias:
+            p["bias"] = jnp.zeros((out_f,), dtype)
+        return p
+
+    keys = jax.random.split(rng, 2 + cfg.num_layers)
+    layers = []
+    for i in range(cfg.num_layers):
+        lk = jax.random.split(keys[2 + i], 7)
+        layers.append(
+            {
+                "input_norm": jnp.ones((H,), dtype),
+                "post_norm": jnp.ones((H,), dtype),
+                "q": dense(lk[0], H, nH * D, cfg.qkv_bias),
+                "k": dense(lk[1], H, nKV * D, cfg.qkv_bias),
+                "v": dense(lk[2], H, nKV * D, cfg.qkv_bias),
+                "o": dense(lk[3], nH * D, H, False),
+                "gate": dense(lk[4], H, I, False),
+                "up": dense(lk[5], H, I, False),
+                "down": dense(lk[6], I, H, False),
+            }
+        )
+    params: Params = {
+        "embed": {
+            "weight": (
+                jax.random.normal(keys[0], (cfg.vocab_size, H), jnp.float32) * 0.02
+            ).astype(dtype)
+        },
+        "layers": layers,
+        "final_norm": jnp.ones((H,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = dense(keys[1], H, cfg.vocab_size, False)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# Building blocks
+# ---------------------------------------------------------------------------
+
+
+def _linear(p: Params, x: jnp.ndarray) -> jnp.ndarray:
+    y = x @ p["kernel"]
+    if "bias" in p:
+        y = y + p["bias"]
+    return y
+
+
+def _qkv(layer: Params, cfg: ModelConfig, x: jnp.ndarray, cos, sin):
+    """Project + rope.  x: [B, S, H] -> q [B,S,nH,D], k/v [B,S,nKV,D]."""
+    B, S, _ = x.shape
+    D = cfg.head_dim_
+    q = _linear(layer["q"], x).reshape(B, S, cfg.num_heads, D)
+    k = _linear(layer["k"], x).reshape(B, S, cfg.num_kv_heads, D)
+    v = _linear(layer["v"], x).reshape(B, S, cfg.num_kv_heads, D)
+    q = apply_rope(q, cos, sin)
+    k = apply_rope(k, cos, sin)
+    return q, k, v
+
+
+def _mlp(layer: Params, x: jnp.ndarray) -> jnp.ndarray:
+    gate = _linear(layer["gate"], x)
+    up = _linear(layer["up"], x)
+    return _linear(layer["down"], jax.nn.silu(gate) * up)
+
+
+def _unembed(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    if cfg.tie_embeddings:
+        logits = x @ params["embed"]["weight"].T
+    else:
+        logits = _linear(params["lm_head"], x)
+    return logits.astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Dense forward (training / parity)
+# ---------------------------------------------------------------------------
+
+
+def forward_full(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    *,
+    positions: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Dense causal forward.  tokens [B, S] -> logits [B, S, V] (float32)."""
+    B, S = tokens.shape
+    x = params["embed"]["weight"][tokens]
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    cos, sin = rope_angles(positions, cfg.head_dim_, cfg.rope_theta)
+    for layer in params["layers"]:
+        h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(layer, cfg, h, cos, sin)
+        attn = causal_attention(q, k, v, q_positions=positions)
+        x = x + _linear(layer["o"], attn.reshape(B, S, -1))
+        h = rms_norm(x, layer["post_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(layer, h)
+    return _unembed(params, cfg, x)
+
+
+# ---------------------------------------------------------------------------
+# Paged-cache scatter
+# ---------------------------------------------------------------------------
+
+
+def _scatter_pages(
+    pages: jnp.ndarray,
+    vals: jnp.ndarray,
+    block_table: jnp.ndarray,
+    positions: jnp.ndarray,
+    valid: jnp.ndarray,
+) -> jnp.ndarray:
+    """Write vals[b, s] to pages[block_table[b, pos//bs], pos%bs].
+
+    Invalid lanes are redirected to the null block 0.
+
+    pages: [num_blocks, bs, KVH, D]; vals: [B, S, KVH, D];
+    block_table: [B, max_blocks]; positions/valid: [B, S].
+    """
+    bs = pages.shape[1]
+    B, S = positions.shape
+    blk_idx = positions // bs                        # [B, S] index into table
+    blk_idx = jnp.clip(blk_idx, 0, block_table.shape[1] - 1)
+    block_ids = jnp.take_along_axis(block_table, blk_idx, axis=1)  # [B, S]
+    block_ids = jnp.where(valid, block_ids, 0)
+    offs = positions % bs
+    flat_blocks = block_ids.reshape(-1)
+    flat_offs = offs.reshape(-1)
+    flat_vals = vals.reshape(B * S, vals.shape[2], vals.shape[3])
+    return pages.at[flat_blocks, flat_offs].set(flat_vals)
+
+
+# ---------------------------------------------------------------------------
+# Prefill
+# ---------------------------------------------------------------------------
+
+
+def prefill(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    lengths: jnp.ndarray,
+    pages: KVPages,
+    block_tables: jnp.ndarray,
+) -> tuple[jnp.ndarray, KVPages]:
+    """Ingest padded prompts, writing K/V into the paged cache.
+
+    Args:
+      tokens: [B, S_pad] int32 (right-padded).
+      lengths: [B] int32 true prompt lengths (0 = inactive lane).
+      pages: paged KV cache.
+      block_tables: [B, max_blocks] int32.
+
+    Returns:
+      (last_logits [B, V] float32, updated pages)
+    """
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
+    valid = positions < lengths[:, None]
+    cos, sin = rope_angles(positions, cfg.head_dim_, cfg.rope_theta)
+
+    x = params["embed"]["weight"][tokens]
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(layer, cfg, h, cos, sin)
+        new_k.append(_scatter_pages(pages.k[li], k, block_tables, positions, valid))
+        new_v.append(_scatter_pages(pages.v[li], v, block_tables, positions, valid))
+        attn = causal_attention(q, k, v, q_positions=positions, kv_len=lengths)
+        x = x + _linear(layer["o"], attn.reshape(B, S, -1))
+        h = rms_norm(x, layer["post_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(layer, h)
+
+    last_idx = jnp.maximum(lengths - 1, 0)
+    x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)  # [B,1,H]
+    logits = _unembed(params, cfg, x_last)[:, 0, :]
+    return logits, KVPages(k=new_k, v=new_v)
+
+
+# ---------------------------------------------------------------------------
+# Decode
+# ---------------------------------------------------------------------------
+
+
+def decode_step(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    context_lens: jnp.ndarray,
+    pages: KVPages,
+    block_tables: jnp.ndarray,
+    *,
+    attn_impl=paged_decode_attention,
+) -> tuple[jnp.ndarray, KVPages]:
+    """One decode step for a batch of slots.
+
+    Args:
+      tokens: [B] int32 — token to feed per slot.
+      context_lens: [B] int32 — tokens already in cache (new token's position).
+        0 means the slot is inactive (its writes go to the null block).
+      pages / block_tables: paged cache state.
+      attn_impl: paged attention implementation (XLA fallback or Pallas).
+
+    Returns:
+      (logits [B, V] float32, updated pages)
+    """
+    B = tokens.shape[0]
+    positions = context_lens[:, None]  # [B, 1]
+    active = (context_lens > 0)[:, None]
+    cos, sin = rope_angles(positions, cfg.head_dim_, cfg.rope_theta)
+
+    x = params["embed"]["weight"][tokens][:, None, :]  # [B, 1, H]
+    new_lens = context_lens + 1
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(layer, cfg, h, cos, sin)
+        pk = _scatter_pages(pages.k[li], k, block_tables, positions, active)
+        pv = _scatter_pages(pages.v[li], v, block_tables, positions, active)
+        new_k.append(pk)
+        new_v.append(pv)
+        attn = attn_impl(q, pk, pv, block_tables, new_lens)
+        x = x + _linear(layer["o"], attn.reshape(B, 1, -1))
+        h = rms_norm(x, layer["post_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(layer, h)
+
+    logits = _unembed(params, cfg, x)[:, 0, :]
+    return logits, KVPages(k=new_k, v=new_v)

@@ -1,0 +1,20 @@
+"""TPU compute primitives: norms, RoPE, attention, sampling."""
+
+from k8s_llm_monitor_tpu.ops.norms import rms_norm
+from k8s_llm_monitor_tpu.ops.rope import apply_rope, rope_angles
+from k8s_llm_monitor_tpu.ops.attention import (
+    causal_attention,
+    decode_attention,
+    paged_decode_attention,
+)
+from k8s_llm_monitor_tpu.ops.sampling import sample_tokens
+
+__all__ = [
+    "rms_norm",
+    "apply_rope",
+    "rope_angles",
+    "causal_attention",
+    "decode_attention",
+    "paged_decode_attention",
+    "sample_tokens",
+]

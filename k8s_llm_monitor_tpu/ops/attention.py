@@ -1,0 +1,145 @@
+"""Attention primitives: dense causal prefill, contiguous-cache decode, and
+paged-KV decode.
+
+All variants are GQA-aware (``num_heads`` query heads grouped over
+``num_kv_heads`` KV heads) and run the softmax in float32.
+
+Layout conventions (chosen for TPU):
+  activations  [batch, seq, heads, head_dim]
+  paged KV     [num_blocks, block_size, kv_heads, head_dim]
+  block table  [batch, max_blocks_per_seq] int32 (block ids; -1 = unused)
+
+The pure-XLA paged path here is the reference implementation and the CPU/test
+fallback; the Pallas TPU kernel lives in ops/pallas_attention.py and is
+selected at runtime by serving/engine.py.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _repeat_kv(x: jnp.ndarray, q_per_kv: int) -> jnp.ndarray:
+    """[..., kv_heads, d] -> [..., kv_heads * q_per_kv, d]."""
+    if q_per_kv == 1:
+        return x
+    return jnp.repeat(x, q_per_kv, axis=-2)
+
+
+def causal_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    *,
+    q_positions: jnp.ndarray | None = None,
+    kv_len: jnp.ndarray | None = None,
+) -> jnp.ndarray:
+    """Dense causal attention for prefill.
+
+    Args:
+      q: [B, S, H, D].
+      k, v: [B, T, KVH, D] with T >= S (T may include a cached prefix).
+      q_positions: [B, S] absolute position of each query token; defaults to
+        arange(S) + (T - S) (i.e. queries are the last S positions of kv).
+      kv_len: [B] valid kv length per sequence (keys at index >= kv_len are
+        masked out).  Defaults to T.
+
+    Returns:
+      [B, S, H, D] in q.dtype.
+    """
+    B, S, H, D = q.shape
+    T, KVH = k.shape[1], k.shape[2]
+    q_per_kv = H // KVH
+
+    k = _repeat_kv(k, q_per_kv)
+    v = _repeat_kv(v, q_per_kv)
+
+    scale = 1.0 / (D ** 0.5)
+    logits = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32), k.astype(jnp.float32))
+    logits *= scale
+
+    if q_positions is None:
+        q_positions = jnp.arange(S, dtype=jnp.int32)[None, :] + (T - S)
+        q_positions = jnp.broadcast_to(q_positions, (B, S))
+    kv_positions = jnp.arange(T, dtype=jnp.int32)
+    causal = q_positions[:, :, None] >= kv_positions[None, None, :]  # [B, S, T]
+    if kv_len is not None:
+        causal = causal & (kv_positions[None, None, :] < kv_len[:, None, None])
+    logits = jnp.where(causal[:, None, :, :], logits, NEG_INF)
+
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhst,bthd->bshd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def decode_attention(
+    q: jnp.ndarray,
+    k_cache: jnp.ndarray,
+    v_cache: jnp.ndarray,
+    lengths: jnp.ndarray,
+) -> jnp.ndarray:
+    """Single-token decode against a contiguous KV cache.
+
+    Args:
+      q: [B, 1, H, D].
+      k_cache, v_cache: [B, T, KVH, D].
+      lengths: [B] int32 — number of valid KV entries per sequence (the new
+        token's K/V must already be written at index lengths-1).
+    """
+    B, _, H, D = q.shape
+    T, KVH = k_cache.shape[1], k_cache.shape[2]
+    q_per_kv = H // KVH
+
+    k = _repeat_kv(k_cache, q_per_kv)
+    v = _repeat_kv(v_cache, q_per_kv)
+
+    scale = 1.0 / (D ** 0.5)
+    logits = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32), k.astype(jnp.float32))
+    logits *= scale
+    valid = jnp.arange(T, dtype=jnp.int32)[None, :] < lengths[:, None]  # [B, T]
+    logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1)
+    out = jnp.einsum("bhst,bthd->bshd", probs, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def gather_pages(
+    pages: jnp.ndarray, block_table: jnp.ndarray
+) -> jnp.ndarray:
+    """Gather a sequence's KV pages into a contiguous view.
+
+    Args:
+      pages: [num_blocks, block_size, KVH, D].
+      block_table: [B, max_blocks] int32 (entries may be -1 / garbage past the
+        sequence's length — callers mask by length).
+
+    Returns:
+      [B, max_blocks * block_size, KVH, D].
+    """
+    B, max_blocks = block_table.shape
+    bs = pages.shape[1]
+    safe = jnp.maximum(block_table, 0)
+    g = pages[safe]  # [B, max_blocks, bs, KVH, D]
+    return g.reshape(B, max_blocks * bs, g.shape[3], g.shape[4])
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    block_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+) -> jnp.ndarray:
+    """Single-token decode against a paged (block) KV cache — XLA reference.
+
+    Gathers each sequence's blocks into a contiguous [B, max_blocks*bs, ...]
+    view then runs masked decode attention.  The Pallas kernel avoids the
+    gather by streaming pages HBM->VMEM per block; this version is the
+    semantics reference and the CPU fallback.
+    """
+    k = gather_pages(k_pages, block_table)
+    v = gather_pages(v_pages, block_table)
+    return decode_attention(q, k, v, lengths)

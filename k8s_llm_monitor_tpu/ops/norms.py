@@ -1,0 +1,35 @@
+"""Normalization primitives.
+
+RMSNorm runs in float32 regardless of activation dtype — bf16 accumulation of
+the mean-square loses enough precision to visibly perturb logits, and XLA fuses
+the up/down casts into the surrounding elementwise ops anyway.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+    """Root-mean-square layer norm (no mean-centering, no bias).
+
+    Llama/Qwen convention: normalize in fp32, scale by ``weight``, cast back.
+    """
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
+    x32 = x32 * (1.0 / jnp.sqrt(var + eps))
+    return (x32 * weight.astype(jnp.float32)).astype(dtype)
+
+
+def layer_norm(
+    x: jnp.ndarray, weight: jnp.ndarray, bias: jnp.ndarray, eps: float = 1e-12
+) -> jnp.ndarray:
+    """Standard LayerNorm (BERT/BGE encoder path)."""
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    mean = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.var(x32, axis=-1, keepdims=True)
+    y = (x32 - mean) / jnp.sqrt(var + eps)
+    y = y * weight.astype(jnp.float32) + bias.astype(jnp.float32)
+    return y.astype(dtype)

@@ -1,0 +1,53 @@
+"""Rotary position embeddings.
+
+Uses the "split-half" rotation convention (rotate_half), matching the
+HuggingFace Llama/Qwen2 implementations so checkpoints load without permuting
+Q/K projection rows.  Angles are computed in float32 and applied in float32,
+then cast back to the activation dtype — bf16 cos/sin tables measurably hurt
+long-context quality.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rope_angles(positions: jnp.ndarray, head_dim: int, theta: float) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """cos/sin tables for the given absolute positions.
+
+    Args:
+      positions: int32 array of any shape ``[...]``.
+      head_dim: per-head dimension (even).
+      theta: RoPE base (5e5 for Llama-3, 1e6 for Qwen2-72B).
+
+    Returns:
+      (cos, sin), each float32 of shape ``[..., head_dim]`` — the half-dim
+      frequency table tiled twice along the last axis (rotate_half convention).
+    """
+    half = head_dim // 2
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
+    ang = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., half]
+    ang = jnp.concatenate([ang, ang], axis=-1)  # [..., head_dim]
+    return jnp.cos(ang), jnp.sin(ang)
+
+
+def _rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    half = x.shape[-1] // 2
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([-x2, x1], axis=-1)
+
+
+def apply_rope(
+    x: jnp.ndarray, cos: jnp.ndarray, sin: jnp.ndarray
+) -> jnp.ndarray:
+    """Rotate ``x`` of shape ``[..., seq, heads, head_dim]``.
+
+    cos/sin have shape ``[..., seq, head_dim]`` and broadcast over the heads
+    axis.
+    """
+    dtype = x.dtype
+    x32 = x.astype(jnp.float32)
+    cos = cos[..., :, None, :]
+    sin = sin[..., :, None, :]
+    out = x32 * cos + _rotate_half(x32) * sin
+    return out.astype(dtype)

@@ -1,0 +1,63 @@
+"""Token sampling: greedy / temperature / top-k / top-p, jit-friendly.
+
+All parameters are per-sequence arrays so a continuously-batched decode step
+can mix greedy and sampled requests in one compiled program (no recompilation
+per sampling config — shapes and dtypes are static).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def sample_tokens(
+    rng: jax.Array,
+    logits: jnp.ndarray,
+    *,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+) -> jnp.ndarray:
+    """Sample next tokens from final-position logits.
+
+    Args:
+      rng: PRNG key.
+      logits: [B, V] float.
+      temperature: [B] float; <= 0 means greedy (argmax).
+      top_k: [B] int32; <= 0 disables top-k.
+      top_p: [B] float; >= 1.0 disables nucleus filtering.
+
+    Returns:
+      [B] int32 token ids.
+    """
+    B, V = logits.shape
+    logits = logits.astype(jnp.float32)
+
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+
+    # --- temperature ---
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    # --- top-k: mask everything below the k-th largest logit ---
+    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B, V] descending
+    k = jnp.clip(top_k, 1, V)
+    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)  # [B, 1]
+    use_topk = (top_k > 0)[:, None]
+    scaled = jnp.where(use_topk & (scaled < kth), -jnp.inf, scaled)
+
+    # --- top-p (nucleus): keep smallest prefix of the sorted distribution with
+    # cumulative prob >= top_p; implemented via the sorted cumulative mass ---
+    sorted_desc2 = jnp.sort(scaled, axis=-1)[:, ::-1]
+    probs_sorted = jax.nn.softmax(sorted_desc2, axis=-1)
+    cum = jnp.cumsum(probs_sorted, axis=-1)
+    # Keep entries where the cumulative mass *before* them is < top_p.
+    keep_sorted = (cum - probs_sorted) < top_p[:, None]
+    # Threshold logit = smallest kept sorted logit.
+    thresh = jnp.min(jnp.where(keep_sorted, sorted_desc2, jnp.inf), axis=-1)
+    use_topp = (top_p < 1.0)[:, None]
+    scaled = jnp.where(use_topp & (scaled < thresh[:, None]), -jnp.inf, scaled)
+
+    sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature <= 0.0, greedy, sampled)

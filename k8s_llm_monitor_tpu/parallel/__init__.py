@@ -1,0 +1,25 @@
+"""Device mesh + GSPMD sharding: DP/TP/SP partitioning over ICI/DCN.
+
+The design follows the scaling-book recipe: pick a mesh, annotate param and
+activation shardings with PartitionSpecs, and let XLA insert the collectives
+(psum after row-parallel matmuls, all-gathers where layouts change).  No
+hand-rolled collective backend — ICI/DCN routing is the XLA runtime's job.
+The reference has no distributed compute at all (SURVEY.md §5.8: its fabric is
+K8s watch streams + HTTP); this subsystem is a new obligation from the
+north-star serving targets (v5e-8 TP, v5p-16 TP for 70B-class).
+"""
+
+from k8s_llm_monitor_tpu.parallel.mesh import MeshConfig, create_mesh
+from k8s_llm_monitor_tpu.parallel.sharding import (
+    param_partition_specs,
+    kv_pages_partition_specs,
+    shard_params,
+)
+
+__all__ = [
+    "MeshConfig",
+    "create_mesh",
+    "param_partition_specs",
+    "kv_pages_partition_specs",
+    "shard_params",
+]

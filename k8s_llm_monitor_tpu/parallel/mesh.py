@@ -1,0 +1,53 @@
+"""Device mesh construction.
+
+Axis conventions used across the framework:
+  ``data``  — data parallel (batch sharding; gradients psum here)
+  ``model`` — tensor parallel (attention heads / MLP hidden; rides ICI)
+  ``seq``   — sequence/context parallel (ring attention for long prompts)
+
+Serving meshes are usually 1D ``model``; training meshes 2D ``data × model``;
+long-context prefill adds ``seq``.  Axes of size 1 are always present so one
+set of PartitionSpecs works on every mesh shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXES = ("data", "seq", "model")
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshConfig:
+    data: int = 1
+    seq: int = 1
+    model: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.data * self.seq * self.model
+
+
+def create_mesh(
+    cfg: MeshConfig | None = None,
+    devices: list | None = None,
+) -> Mesh:
+    """Build a ``data × seq × model`` mesh.
+
+    With no config, all devices go on the ``model`` axis (the serving
+    default: TP over ICI).  Device order follows jax.devices(), which on TPU
+    enumerates chips in ICI-neighbor order, so the innermost (``model``) axis
+    gets the fastest links.
+    """
+    if devices is None:
+        devices = jax.devices()
+    if cfg is None:
+        cfg = MeshConfig(model=len(devices))
+    if cfg.size != len(devices):
+        raise ValueError(f"mesh {cfg} needs {cfg.size} devices, have {len(devices)}")
+    arr = np.asarray(devices).reshape(cfg.data, cfg.seq, cfg.model)
+    return Mesh(arr, AXES)

@@ -1,0 +1,73 @@
+"""Partition specs for the Llama param pytree and KV cache.
+
+Megatron-style tensor parallelism expressed as GSPMD annotations:
+  - column-parallel (shard out_features over ``model``): q/k/v, gate/up
+  - row-parallel    (shard in_features over ``model``):  o, down
+  - vocab-parallel embedding + lm_head
+  - norms replicated
+XLA inserts the psum after row-parallel matmuls automatically from these
+annotations — there is no manual collective in the model code.
+
+KV pages shard the kv-heads axis over ``model``.  For Llama-3-8B (8 KV heads)
+on v5e-8 that is exactly one KV head per chip; for TP degrees beyond the KV
+head count, GSPMD replicates within groups (acceptable: 70B-class keeps
+TP <= 16 with 8 KV heads and XLA handles the partial replication).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from k8s_llm_monitor_tpu.models.config import ModelConfig
+from k8s_llm_monitor_tpu.models.llama import KVPages
+
+# Rules keyed by (parent, leaf) path suffix.
+_COL = {"q", "k", "v", "gate", "up", "lm_head"}   # kernel [in, out] -> shard out
+_ROW = {"o", "down"}                               # kernel [in, out] -> shard in
+
+
+def _spec_for_path(path: tuple) -> P:
+    keys = [p.key for p in path if isinstance(p, jax.tree_util.DictKey)]
+    leaf = keys[-1]
+    parent = keys[-2] if len(keys) > 1 else ""
+    if parent == "embed" and leaf == "weight":
+        return P("model", None)                    # vocab-parallel
+    if leaf == "kernel":
+        if parent in _COL:
+            return P(None, "model")
+        if parent in _ROW:
+            return P("model", None)
+    if leaf == "bias":
+        return P("model") if parent in _COL else P(None)
+    # norms and anything else: replicated
+    return P(None)
+
+
+def param_partition_specs(params: Any) -> Any:
+    """PartitionSpec pytree matching a llama param pytree."""
+    return jax.tree_util.tree_map_with_path(lambda p, _: _spec_for_path(p), params)
+
+
+def kv_pages_partition_specs(pages: KVPages) -> KVPages:
+    """[num_blocks, block_size, kv_heads, head_dim] -> shard kv_heads."""
+    spec = P(None, None, "model", None)
+    return KVPages(
+        k=[spec for _ in pages.k],
+        v=[spec for _ in pages.v],
+    )
+
+
+def shard_params(params: Any, mesh: Mesh) -> Any:
+    """Device-put params with TP sharding over ``mesh``."""
+    specs = param_partition_specs(params)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def batch_spec() -> P:
+    """Activation batch sharding: batch over ``data``."""
+    return P("data")

@@ -1,0 +1,19 @@
+"""Inference serving: paged KV allocator + continuous-batching engine."""
+
+from k8s_llm_monitor_tpu.serving.kv_cache import BlockAllocator
+from k8s_llm_monitor_tpu.serving.engine import (
+    EngineConfig,
+    GenerationRequest,
+    GenerationResult,
+    InferenceEngine,
+    SamplingParams,
+)
+
+__all__ = [
+    "BlockAllocator",
+    "EngineConfig",
+    "GenerationRequest",
+    "GenerationResult",
+    "InferenceEngine",
+    "SamplingParams",
+]

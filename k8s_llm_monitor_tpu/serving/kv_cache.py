@@ -1,0 +1,59 @@
+"""Host-side paged KV-cache block allocator.
+
+The device-side page arrays live in models/llama.py (KVPages); this class
+owns the free list and per-sequence block accounting.  Block id 0 is the
+null block — masked lanes in prefill/decode scatter there — so it is never
+handed out.
+
+Deliberately simple (free-list LIFO, no copy-on-write / prefix sharing yet);
+the continuous-batching engine calls alloc/extend/free on request admission,
+block-boundary crossings, and completion.
+"""
+
+from __future__ import annotations
+
+
+class OutOfBlocks(Exception):
+    pass
+
+
+class BlockAllocator:
+    def __init__(self, num_blocks: int, block_size: int):
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (block 0 is reserved)")
+        self.num_blocks = num_blocks
+        self.block_size = block_size
+        self._free: list[int] = list(range(num_blocks - 1, 0, -1))  # pop -> 1,2,...
+
+    @property
+    def free_blocks(self) -> int:
+        return len(self._free)
+
+    def blocks_for(self, num_tokens: int) -> int:
+        return (num_tokens + self.block_size - 1) // self.block_size
+
+    def can_alloc(self, num_tokens: int) -> bool:
+        return self.blocks_for(num_tokens) <= len(self._free)
+
+    def alloc(self, num_tokens: int) -> list[int]:
+        n = self.blocks_for(num_tokens)
+        if n > len(self._free):
+            raise OutOfBlocks(f"need {n} blocks, {len(self._free)} free")
+        return [self._free.pop() for _ in range(n)]
+
+    def extend(self, blocks: list[int], new_len: int) -> None:
+        """Grow ``blocks`` in place to cover ``new_len`` tokens."""
+        need = self.blocks_for(new_len) - len(blocks)
+        if need <= 0:
+            return
+        if need > len(self._free):
+            raise OutOfBlocks(f"need {need} more blocks, {len(self._free)} free")
+        for _ in range(need):
+            blocks.append(self._free.pop())
+
+    def free(self, blocks: list[int]) -> None:
+        for b in blocks:
+            if b == 0:
+                raise ValueError("attempt to free the null block")
+            self._free.append(b)
+        blocks.clear()

@@ -1,0 +1,161 @@
+"""Checkpoint IO: HuggingFace safetensors -> JAX params, plus orbax-native
+save/restore.
+
+Weight-layout note: HF/torch ``nn.Linear`` stores ``[out, in]``; our kernels
+are ``[in, out]`` (see models/llama.py).  The transpose happens exactly once,
+here, at load time — never in the forward pass.
+
+Supports the two checkpoint families from BASELINE.md: Llama-3 (no biases)
+and Qwen2 (QKV biases), in single-file or index-sharded safetensors form.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+from typing import Any, Iterable, Mapping
+
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_llm_monitor_tpu.models.config import ModelConfig
+
+Params = dict[str, Any]
+
+
+def config_from_hf(hf: Mapping[str, Any], name: str = "hf-model") -> ModelConfig:
+    """Translate a HF ``config.json`` dict (LlamaConfig/Qwen2Config) to ours."""
+    num_heads = hf["num_attention_heads"]
+    return ModelConfig(
+        name=name,
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=num_heads,
+        num_kv_heads=hf.get("num_key_value_heads", num_heads),
+        head_dim=hf.get("head_dim"),
+        rope_theta=hf.get("rope_theta", 10_000.0),
+        rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
+        max_seq_len=hf.get("max_position_embeddings", 8192),
+        qkv_bias=hf.get("model_type") == "qwen2",
+        tie_embeddings=hf.get("tie_word_embeddings", False),
+    )
+
+
+_LINEAR_MAP = {
+    "q": "self_attn.q_proj",
+    "k": "self_attn.k_proj",
+    "v": "self_attn.v_proj",
+    "o": "self_attn.o_proj",
+    "gate": "mlp.gate_proj",
+    "up": "mlp.up_proj",
+    "down": "mlp.down_proj",
+}
+
+
+def convert_hf_state_dict(
+    state: Mapping[str, np.ndarray], cfg: ModelConfig, dtype: str | None = None
+) -> Params:
+    """Map a HF Llama/Qwen2 state dict (numpy arrays) to our param pytree."""
+    dt = jnp.dtype(dtype or cfg.dtype)
+
+    def get(name: str) -> jnp.ndarray:
+        return jnp.asarray(np.asarray(state[name]), dtype=dt)
+
+    layers = []
+    for i in range(cfg.num_layers):
+        pre = f"model.layers.{i}."
+        layer: Params = {
+            "input_norm": get(pre + "input_layernorm.weight"),
+            "post_norm": get(pre + "post_attention_layernorm.weight"),
+        }
+        for ours, theirs in _LINEAR_MAP.items():
+            p: Params = {"kernel": get(f"{pre}{theirs}.weight").T}
+            bias_key = f"{pre}{theirs}.bias"
+            if bias_key in state:
+                p["bias"] = get(bias_key)
+            layer[ours] = p
+        layers.append(layer)
+
+    params: Params = {
+        "embed": {"weight": get("model.embed_tokens.weight")},
+        "layers": layers,
+        "final_norm": get("model.norm.weight"),
+    }
+    if not cfg.tie_embeddings:
+        if "lm_head.weight" in state:
+            params["lm_head"] = {"kernel": get("lm_head.weight").T}
+        else:  # checkpoint ties but config didn't say so
+            params["lm_head"] = {"kernel": get("model.embed_tokens.weight").T}
+    return params
+
+
+class _SafetensorsDict(Mapping[str, np.ndarray]):
+    """Lazy mapping over (possibly sharded) safetensors files."""
+
+    def __init__(self, model_dir: pathlib.Path):
+        from safetensors import safe_open
+
+        self._files: dict[str, pathlib.Path] = {}
+        index = model_dir / "model.safetensors.index.json"
+        if index.exists():
+            weight_map = json.loads(index.read_text())["weight_map"]
+            for key, fname in weight_map.items():
+                self._files[key] = model_dir / fname
+        else:
+            for f in sorted(model_dir.glob("*.safetensors")):
+                with safe_open(str(f), framework="np") as sf:
+                    for key in sf.keys():
+                        self._files[key] = f
+        self._safe_open = safe_open
+
+    def __getitem__(self, key: str) -> np.ndarray:
+        with self._safe_open(str(self._files[key]), framework="np") as sf:
+            return sf.get_tensor(key)
+
+    def __iter__(self) -> Iterable[str]:
+        return iter(self._files)
+
+    def __len__(self) -> int:
+        return len(self._files)
+
+    def __contains__(self, key: object) -> bool:
+        return key in self._files
+
+
+def load_hf_checkpoint(
+    model_dir: str | pathlib.Path, dtype: str | None = None
+) -> tuple[ModelConfig, Params]:
+    """Load a HF-format model directory (config.json + safetensors)."""
+    model_dir = pathlib.Path(model_dir)
+    hf_cfg = json.loads((model_dir / "config.json").read_text())
+    cfg = ModelConfig(**{
+        **config_from_hf(hf_cfg, name=model_dir.name).__dict__,
+        **({"dtype": dtype} if dtype else {}),
+    })
+    state = _SafetensorsDict(model_dir)
+    return cfg, convert_hf_state_dict(state, cfg, dtype=dtype)
+
+
+# ---------------------------------------------------------------------------
+# Orbax-native checkpoints (training / snapshot persistence)
+# ---------------------------------------------------------------------------
+
+
+def save_checkpoint(path: str | pathlib.Path, params: Params) -> None:
+    import orbax.checkpoint as ocp
+
+    path = pathlib.Path(path).absolute()
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(path, params, force=True)
+
+
+def restore_checkpoint(path: str | pathlib.Path, like: Params | None = None) -> Params:
+    import orbax.checkpoint as ocp
+
+    path = pathlib.Path(path).absolute()
+    with ocp.StandardCheckpointer() as ckptr:
+        if like is not None:
+            return ckptr.restore(path, like)
+        return ckptr.restore(path)

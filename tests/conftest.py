@@ -1,0 +1,29 @@
+"""Test configuration: force JAX onto a virtual 8-device CPU mesh.
+
+Must run before the first ``import jax`` anywhere in the test session, which
+pytest guarantees by importing conftest first.  All sharding tests target this
+virtual mesh; the driver separately validates the multi-chip path via
+__graft_entry__.dryrun_multichip.
+"""
+
+import os
+
+# Force, don't setdefault: the session environment pins JAX_PLATFORMS to the
+# real TPU tunnel, and tests must never contend for that single chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh_devices():
+    import jax
+
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs

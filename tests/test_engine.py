@@ -1,0 +1,147 @@
+"""Continuous-batching engine: correctness against naive generation,
+preemption under page pressure, mixed sampling configs, text round-trip.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from k8s_llm_monitor_tpu.models import llama
+from k8s_llm_monitor_tpu.models.config import ModelConfig
+from k8s_llm_monitor_tpu.serving.engine import (
+    EngineConfig,
+    GenerationRequest,
+    InferenceEngine,
+    SamplingParams,
+)
+from k8s_llm_monitor_tpu.utils.tokenizer import ByteTokenizer
+
+CFG = ModelConfig(name="t", vocab_size=300, hidden_size=32, intermediate_size=64,
+                  num_layers=2, num_heads=4, num_kv_heads=2, dtype="float32",
+                  rope_theta=10_000.0)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _naive_greedy(params, prompt, n):
+    seq = list(prompt)
+    for _ in range(n):
+        logits = llama.forward_full(params, CFG, jnp.asarray([seq], jnp.int32))
+        seq.append(int(jnp.argmax(logits[0, -1])))
+    return seq[len(prompt):]
+
+
+def test_greedy_matches_naive(params):
+    eng = InferenceEngine(
+        CFG, params,
+        EngineConfig(max_slots=4, num_blocks=64, block_size=8,
+                     max_blocks_per_seq=16, prefill_buckets=(16, 32)),
+        eos_id=-1,
+    )
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(3, 300, size=n)) for n in (5, 11, 3)]
+    results = eng.generate(prompts, SamplingParams(max_tokens=8, temperature=0.0))
+    for p, r in zip(prompts, results):
+        assert r.finish_reason == "length"
+        assert r.token_ids == _naive_greedy(params, p, 8), "continuous batch != naive"
+        assert r.ttft_s >= 0 and r.latency_s >= r.ttft_s
+
+
+def test_staggered_admission(params):
+    """More requests than slots: later requests admitted as slots free up."""
+    eng = InferenceEngine(
+        CFG, params,
+        EngineConfig(max_slots=2, num_blocks=64, block_size=8,
+                     max_blocks_per_seq=16, prefill_buckets=(16,)),
+        eos_id=-1,
+    )
+    rng = np.random.default_rng(1)
+    prompts = [list(rng.integers(3, 300, size=6)) for _ in range(5)]
+    results = eng.generate(prompts, SamplingParams(max_tokens=5))
+    assert len(results) == 5
+    for p, r in zip(prompts, results):
+        assert r.token_ids == _naive_greedy(params, p, 5)
+
+
+def test_preemption_under_page_pressure(params):
+    """Tiny pool forces eviction; outputs must still match naive decoding."""
+    eng = InferenceEngine(
+        CFG, params,
+        EngineConfig(max_slots=3, num_blocks=14, block_size=4,
+                     max_blocks_per_seq=16, prefill_buckets=(16,)),
+        eos_id=-1,
+    )
+    rng = np.random.default_rng(2)
+    prompts = [list(rng.integers(3, 300, size=7)) for _ in range(3)]
+    results = eng.generate(prompts, SamplingParams(max_tokens=12))
+    for p, r in zip(prompts, results):
+        assert r.token_ids == _naive_greedy(params, p, 12)
+    assert eng.preemptions > 0, "test did not actually exercise preemption"
+
+
+def test_eos_stops_generation(params):
+    eng = InferenceEngine(
+        CFG, params,
+        EngineConfig(max_slots=2, num_blocks=64, block_size=8,
+                     max_blocks_per_seq=16, prefill_buckets=(16,)),
+        eos_id=-1,
+    )
+    prompt = list(range(3, 10))
+    free = _naive_greedy(params, prompt, 20)
+    eos = free[4]  # pretend the 5th generated token is EOS
+    eng.eos_id = eos
+    [r] = eng.generate([prompt], SamplingParams(max_tokens=20))
+    assert r.finish_reason == "eos"
+    assert r.token_ids == free[:4]
+
+
+def test_sampling_with_seed_is_reproducible(params):
+    def run(seed):
+        eng = InferenceEngine(
+            CFG, params,
+            EngineConfig(max_slots=2, num_blocks=64, block_size=8,
+                         max_blocks_per_seq=16, prefill_buckets=(16,)),
+            eos_id=-1, seed=seed,
+        )
+        [r] = eng.generate([[5, 6, 7, 8]],
+                           SamplingParams(max_tokens=10, temperature=0.8, top_k=40))
+        return r.token_ids
+
+    assert run(7) == run(7)
+    # Not a hard requirement, but with temp 0.8 two seeds matching for all 10
+    # tokens would indicate sampling ignores the rng.
+    assert run(7) != run(8)
+
+
+def test_text_roundtrip(params):
+    tok = ByteTokenizer()
+    eng = InferenceEngine(
+        CFG, params,
+        EngineConfig(max_slots=2, num_blocks=64, block_size=8,
+                     max_blocks_per_seq=16, prefill_buckets=(16, 32)),
+        tokenizer=tok,
+    )
+    out = eng.generate_text("pod crashloop", SamplingParams(max_tokens=6))
+    assert isinstance(out, str)
+
+
+def test_submit_poll_async_api(params):
+    eng = InferenceEngine(
+        CFG, params,
+        EngineConfig(max_slots=2, num_blocks=64, block_size=8,
+                     max_blocks_per_seq=16, prefill_buckets=(16,)),
+        eos_id=-1,
+    )
+    eng.submit(GenerationRequest("a", [5, 6, 7], SamplingParams(max_tokens=4)))
+    eng.submit(GenerationRequest("b", [9, 10], SamplingParams(max_tokens=4)))
+    assert eng.poll("a") is None
+    while eng.has_work:
+        eng.step()
+    ra, rb = eng.poll("a"), eng.poll("b")
+    assert ra is not None and rb is not None
+    assert len(ra.token_ids) == 4 and len(rb.token_ids) == 4

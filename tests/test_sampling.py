@@ -1,0 +1,70 @@
+"""Sampling op: greedy/temperature/top-k/top-p semantics."""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from k8s_llm_monitor_tpu.ops.sampling import sample_tokens
+
+
+def _sample(logits, temperature, top_k, top_p, seed=0):
+    B = logits.shape[0]
+    return np.asarray(sample_tokens(
+        jax.random.PRNGKey(seed), jnp.asarray(logits, jnp.float32),
+        temperature=jnp.full((B,), temperature, jnp.float32),
+        top_k=jnp.full((B,), top_k, jnp.int32),
+        top_p=jnp.full((B,), top_p, jnp.float32),
+    ))
+
+
+def test_greedy():
+    logits = np.array([[0.1, 3.0, -1.0], [2.0, 0.0, 1.9]])
+    out = _sample(logits, temperature=0.0, top_k=0, top_p=1.0)
+    assert out.tolist() == [1, 0]
+
+
+def test_top_k_restricts_support():
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(1, 50)).astype(np.float32)
+    top2 = set(np.argsort(logits[0])[-2:].tolist())
+    seen = set()
+    for seed in range(50):
+        seen.add(int(_sample(logits, 1.0, 2, 1.0, seed=seed)[0]))
+    assert seen <= top2
+    assert len(seen) == 2  # both top-2 tokens reachable
+
+
+def test_top_p_restricts_support():
+    # one dominant token (p ~ .97) -> top_p=0.9 keeps only it
+    logits = np.zeros((1, 10), np.float32)
+    logits[0, 3] = 5.0
+    for seed in range(30):
+        assert int(_sample(logits, 1.0, 0, 0.9, seed=seed)[0]) == 3
+
+
+def test_top_p_keeps_minimum_one_token():
+    logits = np.zeros((1, 4), np.float32)  # uniform: every token has mass .25
+    outs = {int(_sample(logits, 1.0, 0, 0.1, seed=s)[0]) for s in range(20)}
+    # cum-before < 0.1 keeps exactly the single largest-sorted entry
+    assert len(outs) == 1
+
+
+def test_mixed_batch_greedy_and_sampled():
+    logits = np.array([[0.0, 4.0, 0.0, 0.0]] * 2, np.float32)
+    out = np.asarray(sample_tokens(
+        jax.random.PRNGKey(0), jnp.asarray(logits),
+        temperature=jnp.asarray([0.0, 1.0], jnp.float32),
+        top_k=jnp.asarray([0, 0], jnp.int32),
+        top_p=jnp.asarray([1.0, 1.0], jnp.float32),
+    ))
+    assert out[0] == 1  # greedy lane
+    assert 0 <= out[1] < 4
+
+
+def test_temperature_sharpens():
+    rng = np.random.default_rng(1)
+    logits = rng.normal(size=(1, 20)).astype(np.float32)
+    best = int(np.argmax(logits[0]))
+    cold = [int(_sample(logits, 0.05, 0, 1.0, seed=s)[0]) for s in range(20)]
+    assert all(t == best for t in cold)
