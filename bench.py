@@ -1,0 +1,129 @@
+#!/usr/bin/env python
+"""Single-chip serving benchmark — the north-star SLO tracker.
+
+Measures p50 TTFT for a burst of concurrent diagnosis-sized queries through
+the continuous-batching engine (BASELINE.md config #4, scaled to the one
+available chip), plus decode throughput, and prints ONE JSON line:
+
+    {"metric": "p50_ttft_100c_ms", "value": <ms>, "unit": "ms",
+     "vs_baseline": <500ms / p50>, ...}
+
+``vs_baseline`` is measured against the north-star SLO (p50 TTFT < 500 ms,
+BASELINE.md / BASELINE.json north_star) since the reference publishes no
+benchmark numbers of its own (verified in SURVEY.md §6): > 1.0 beats the SLO.
+
+Model: LLAMA_1B preset (models/config.py) with random-init bf16 weights —
+the per-chip arithmetic matches the 8B-on-v5e-8 target within a small factor
+and leaves HBM headroom for the KV pool on a 16 GB chip.
+
+Run: ``python bench.py`` (uses the default JAX platform — the real TPU under
+the driver; set BENCH_CONCURRENCY / BENCH_MODEL / JAX_PLATFORMS=cpu to
+shrink for local smoke runs).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main() -> None:
+    t0 = time.monotonic()
+    import numpy as np
+    import jax
+
+    from k8s_llm_monitor_tpu.models import llama
+    from k8s_llm_monitor_tpu.models.config import PRESETS
+    from k8s_llm_monitor_tpu.serving.engine import (
+        EngineConfig,
+        GenerationRequest,
+        InferenceEngine,
+        SamplingParams,
+    )
+
+    model_name = os.environ.get("BENCH_MODEL", "llama-1b")
+    n_requests = int(os.environ.get("BENCH_CONCURRENCY", "100"))
+    prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", "192"))
+    max_tokens = int(os.environ.get("BENCH_MAX_TOKENS", "48"))
+
+    cfg = PRESETS[model_name]
+    dev = jax.devices()[0]
+    log(f"bench: {model_name} on {dev.platform}:{dev.device_kind} "
+        f"({n_requests} concurrent, prompt {prompt_len}, gen {max_tokens})")
+
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    ecfg = EngineConfig(
+        max_slots=32,
+        num_blocks=2048,
+        block_size=16,
+        max_blocks_per_seq=32,
+        prefill_buckets=(256,),
+        max_prefills_per_step=4,
+    )
+    eng = InferenceEngine(cfg, params, ecfg, eos_id=-1)
+
+    rng = np.random.default_rng(0)
+
+    def prompt() -> list[int]:
+        return list(rng.integers(4, cfg.vocab_size - 4, size=prompt_len))
+
+    # Warm up every compiled shape (prefill bucket, decode step, sampler) so
+    # measured TTFT excludes compile time.
+    log("warmup (compiles prefill/decode)...")
+    wt0 = time.monotonic()
+    eng.generate([prompt() for _ in range(2)], SamplingParams(max_tokens=3))
+    log(f"warmup done in {time.monotonic() - wt0:.1f}s")
+
+    # --- concurrent burst: all requests queued at t=0, engine drains ---
+    bt0 = time.monotonic()
+    for i in range(n_requests):
+        eng.submit(GenerationRequest(
+            request_id=f"bench-{i}",
+            prompt_ids=prompt(),
+            sampling=SamplingParams(max_tokens=max_tokens),
+        ))
+    steps0, prefills0 = eng.steps, eng.prefills
+    while eng.has_work:
+        eng.step()
+    wall = time.monotonic() - bt0
+
+    results = [eng.poll(f"bench-{i}") for i in range(n_requests)]
+    assert all(r is not None and r.finish_reason != "error" for r in results)
+    ttfts = np.array(sorted(r.ttft_s for r in results))
+    total_tokens = sum(len(r.token_ids) for r in results)
+    p50 = float(np.percentile(ttfts, 50))
+    p99 = float(np.percentile(ttfts, 99))
+    toks_per_s = total_tokens / wall
+
+    log(f"drained {n_requests} requests in {wall:.2f}s "
+        f"({eng.steps - steps0} steps, {eng.prefills - prefills0} prefills, "
+        f"{eng.preemptions} preemptions)")
+    log(f"p50 TTFT {p50 * 1e3:.1f} ms | p99 {p99 * 1e3:.1f} ms | "
+        f"throughput {toks_per_s:.0f} tok/s | total {time.monotonic()-t0:.0f}s")
+
+    print(json.dumps({
+        "metric": "p50_ttft_100c_ms",
+        "value": round(p50 * 1e3, 2),
+        "unit": "ms",
+        "vs_baseline": round(0.5 / p50, 3) if p50 > 0 else 0.0,
+        "extras": {
+            "model": model_name,
+            "concurrency": n_requests,
+            "prompt_len": prompt_len,
+            "max_tokens": max_tokens,
+            "p99_ttft_ms": round(p99 * 1e3, 2),
+            "throughput_tok_s": round(toks_per_s, 1),
+            "wall_s": round(wall, 2),
+            "platform": dev.platform,
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

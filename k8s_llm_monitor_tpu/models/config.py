@@ -29,6 +29,9 @@ class ModelConfig:
     num_kv_heads: int = 2
     head_dim: Optional[int] = None  # defaults to hidden_size // num_heads
     rope_theta: float = 500_000.0
+    # HF-style rope_scaling dict (e.g. Llama-3.1's {"rope_type": "llama3",
+    # "factor": 8.0, ...}); None = unscaled.  See ops/rope.py.
+    rope_scaling: Optional[dict] = None
     rms_norm_eps: float = 1e-5
     max_seq_len: int = 8192
     qkv_bias: bool = False          # True for Qwen2

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from k8s_llm_monitor_tpu.models.config import ModelConfig
 from k8s_llm_monitor_tpu.ops.attention import (
     causal_attention,
+    gather_pages,
     paged_decode_attention,
 )
 from k8s_llm_monitor_tpu.ops.norms import rms_norm
@@ -173,7 +174,8 @@ def forward_full(
     x = params["embed"]["weight"][tokens]
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
-    cos, sin = rope_angles(positions, cfg.head_dim_, cfg.rope_theta)
+    cos, sin = rope_angles(positions, cfg.head_dim_, cfg.rope_theta,
+                           scaling=cfg.rope_scaling)
     for layer in params["layers"]:
         h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(layer, cfg, h, cos, sin)
@@ -221,6 +223,54 @@ def _scatter_pages(
 # ---------------------------------------------------------------------------
 
 
+def _prefill_impl(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    positions: jnp.ndarray,
+    valid: jnp.ndarray,
+    lengths: jnp.ndarray,
+    kv_len: jnp.ndarray,
+    pages: KVPages,
+    block_tables: jnp.ndarray,
+    attend_to_pages: bool,
+) -> tuple[jnp.ndarray, KVPages]:
+    """Shared prefill layer loop.
+
+    ``attend_to_pages`` selects the attention K/V source: False = the chunk's
+    own in-flight k/v (first chunk, positions start at 0); True = gather the
+    paged cache after scattering (continuation chunks attending to a cached
+    prefix).  Everything else — embed, qkv+rope, scatter, residual/MLP,
+    last-valid-token unembed — is identical and lives here exactly once.
+    """
+    B, S = tokens.shape
+    cos, sin = rope_angles(positions, cfg.head_dim_, cfg.rope_theta,
+                           scaling=cfg.rope_scaling)
+
+    x = params["embed"]["weight"][tokens]
+    new_k, new_v = [], []
+    for li, layer in enumerate(params["layers"]):
+        h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+        q, k, v = _qkv(layer, cfg, h, cos, sin)
+        pk = _scatter_pages(pages.k[li], k, block_tables, positions, valid)
+        pv = _scatter_pages(pages.v[li], v, block_tables, positions, valid)
+        new_k.append(pk)
+        new_v.append(pv)
+        if attend_to_pages:
+            kk, vv = gather_pages(pk, block_tables), gather_pages(pv, block_tables)
+        else:
+            kk, vv = k, v
+        attn = causal_attention(q, kk, vv, q_positions=positions, kv_len=kv_len)
+        x = x + _linear(layer["o"], attn.reshape(B, S, -1))
+        h = rms_norm(x, layer["post_norm"], cfg.rms_norm_eps)
+        x = x + _mlp(layer, h)
+
+    last_idx = jnp.maximum(lengths - 1, 0)
+    x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)  # [B,1,H]
+    logits = _unembed(params, cfg, x_last)[:, 0, :]
+    return logits, KVPages(k=new_k, v=new_v)
+
+
 def prefill(
     params: Params,
     cfg: ModelConfig,
@@ -243,24 +293,44 @@ def prefill(
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     valid = positions < lengths[:, None]
-    cos, sin = rope_angles(positions, cfg.head_dim_, cfg.rope_theta)
+    return _prefill_impl(params, cfg, tokens, positions, valid, lengths,
+                         lengths, pages, block_tables, attend_to_pages=False)
 
-    x = params["embed"]["weight"][tokens]
-    new_k, new_v = [], []
-    for li, layer in enumerate(params["layers"]):
-        h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(layer, cfg, h, cos, sin)
-        new_k.append(_scatter_pages(pages.k[li], k, block_tables, positions, valid))
-        new_v.append(_scatter_pages(pages.v[li], v, block_tables, positions, valid))
-        attn = causal_attention(q, k, v, q_positions=positions, kv_len=lengths)
-        x = x + _linear(layer["o"], attn.reshape(B, S, -1))
-        h = rms_norm(x, layer["post_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(layer, h)
 
-    last_idx = jnp.maximum(lengths - 1, 0)
-    x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)  # [B,1,H]
-    logits = _unembed(params, cfg, x_last)[:, 0, :]
-    return logits, KVPages(k=new_k, v=new_v)
+def prefill_chunk(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    start: jnp.ndarray,
+    lengths: jnp.ndarray,
+    pages: KVPages,
+    block_tables: jnp.ndarray,
+) -> tuple[jnp.ndarray, KVPages]:
+    """Continuation prefill: ingest a chunk of a prompt whose first ``start``
+    tokens are already in the paged cache.
+
+    Used for (a) prompts longer than the largest prefill bucket and (b)
+    re-admission after recompute-preemption, where the folded prompt can
+    exceed any single bucket.  Unlike ``prefill``, attention here runs
+    against the paged cache (prefix + chunk) rather than the in-flight
+    buffer, masked causally by absolute position.
+
+    Args:
+      tokens: [B, S] chunk tokens (right-padded).
+      start: [B] int32 — tokens already in the cache for each sequence.
+      lengths: [B] int32 — valid tokens in this chunk (0 = inactive lane).
+      pages / block_tables: paged cache state.
+
+    Returns:
+      (last-chunk-token logits [B, V] float32, updated pages)
+    """
+    B, S = tokens.shape
+    offs = jnp.arange(S, dtype=jnp.int32)
+    positions = start[:, None] + offs[None, :]
+    valid = offs[None, :] < lengths[:, None]
+    return _prefill_impl(params, cfg, tokens, positions, valid, lengths,
+                         start + lengths, pages, block_tables,
+                         attend_to_pages=True)
 
 
 # ---------------------------------------------------------------------------
@@ -293,7 +363,8 @@ def decode_step(
     B = tokens.shape[0]
     positions = context_lens[:, None]  # [B, 1]
     active = (context_lens > 0)[:, None]
-    cos, sin = rope_angles(positions, cfg.head_dim_, cfg.rope_theta)
+    cos, sin = rope_angles(positions, cfg.head_dim_, cfg.rope_theta,
+                           scaling=cfg.rope_scaling)
 
     x = params["embed"]["weight"][tokens][:, None, :]  # [B, 1, H]
     new_lens = context_lens + 1

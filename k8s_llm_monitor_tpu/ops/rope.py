@@ -9,24 +9,73 @@ long-context quality.
 
 from __future__ import annotations
 
+from typing import Mapping, Optional
+
 import jax.numpy as jnp
+import numpy as np
 
 
-def rope_angles(positions: jnp.ndarray, head_dim: int, theta: float) -> tuple[jnp.ndarray, jnp.ndarray]:
+def _llama3_scale_inv_freq(
+    inv_freq: np.ndarray, scaling: Mapping[str, float]
+) -> np.ndarray:
+    """Llama-3.1 ``rope_scaling`` (``rope_type: "llama3"``) frequency warp.
+
+    Low frequencies (wavelength > low_freq_wavelen) are divided by ``factor``;
+    high frequencies pass through; the band between interpolates smoothly.
+    Computed host-side in numpy — the result is a compile-time constant.
+    """
+    factor = float(scaling.get("factor", 8.0))
+    low_freq_factor = float(scaling.get("low_freq_factor", 1.0))
+    high_freq_factor = float(scaling.get("high_freq_factor", 4.0))
+    old_ctx = float(scaling.get("original_max_position_embeddings", 8192))
+
+    wavelen = 2.0 * np.pi / inv_freq
+    low_freq_wavelen = old_ctx / low_freq_factor
+    high_freq_wavelen = old_ctx / high_freq_factor
+
+    smooth = (old_ctx / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor
+    )
+    smoothed = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+    out = np.where(wavelen > low_freq_wavelen, inv_freq / factor, inv_freq)
+    mid = (wavelen <= low_freq_wavelen) & (wavelen >= high_freq_wavelen)
+    return np.where(mid, smoothed, out).astype(np.float32)
+
+
+def rope_angles(
+    positions: jnp.ndarray,
+    head_dim: int,
+    theta: float,
+    scaling: Optional[Mapping[str, float]] = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
     """cos/sin tables for the given absolute positions.
 
     Args:
       positions: int32 array of any shape ``[...]``.
       head_dim: per-head dimension (even).
       theta: RoPE base (5e5 for Llama-3, 1e6 for Qwen2-72B).
+      scaling: optional HF ``rope_scaling`` dict.  ``rope_type``/``type`` of
+        ``"llama3"`` applies the Llama-3.1 frequency warp; ``"linear"``
+        divides positions by ``factor``; None/``"default"`` is identity.
 
     Returns:
       (cos, sin), each float32 of shape ``[..., head_dim]`` — the half-dim
       frequency table tiled twice along the last axis (rotate_half convention).
     """
     half = head_dim // 2
-    inv_freq = 1.0 / (theta ** (jnp.arange(0, half, dtype=jnp.float32) / half))
-    ang = positions.astype(jnp.float32)[..., None] * inv_freq  # [..., half]
+    inv_freq = 1.0 / (
+        theta ** (np.arange(0, half, dtype=np.float32) / half)
+    )
+    pos = positions.astype(jnp.float32)
+    if scaling:
+        kind = scaling.get("rope_type", scaling.get("type", "default"))
+        if kind == "llama3":
+            inv_freq = _llama3_scale_inv_freq(inv_freq, scaling)
+        elif kind == "linear":
+            pos = pos / float(scaling.get("factor", 1.0))
+        elif kind not in ("default", None):
+            raise NotImplementedError(f"rope_scaling type {kind!r}")
+    ang = pos[..., None] * jnp.asarray(inv_freq)  # [..., half]
     ang = jnp.concatenate([ang, ang], axis=-1)  # [..., head_dim]
     return jnp.cos(ang), jnp.sin(ang)
 

@@ -3,12 +3,26 @@
 All parameters are per-sequence arrays so a continuously-batched decode step
 can mix greedy and sampled requests in one compiled program (no recompilation
 per sampling config — shapes and dtypes are static).
+
+Top-k and top-p are both expressed as *rank* cutoffs over one descending
+argsort: ranks are unique even when logits tie, so a tied distribution can
+never defeat the nucleus mask (a strict value-threshold comparison would keep
+every tied token and make ``top_p=0.1`` a no-op on uniform logits).
+
+``greedy_tokens`` is the sort-free fast path — serving/engine.py dispatches
+to it when every active lane in a decode step is greedy (a pure argmax, no
+[B, V] sort traffic).
 """
 
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+
+def greedy_tokens(logits: jnp.ndarray) -> jnp.ndarray:
+    """Argmax sampling, [B, V] -> [B] int32. No sorting, no rng."""
+    return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
 def sample_tokens(
@@ -34,30 +48,35 @@ def sample_tokens(
     B, V = logits.shape
     logits = logits.astype(jnp.float32)
 
-    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    greedy = greedy_tokens(logits)
 
-    # --- temperature ---
     temp = jnp.maximum(temperature, 1e-6)[:, None]
     scaled = logits / temp
 
-    # --- top-k: mask everything below the k-th largest logit ---
-    sorted_desc = jnp.sort(scaled, axis=-1)[:, ::-1]  # [B, V] descending
-    k = jnp.clip(top_k, 1, V)
-    kth = jnp.take_along_axis(sorted_desc, (k - 1)[:, None], axis=-1)  # [B, 1]
-    use_topk = (top_k > 0)[:, None]
-    scaled = jnp.where(use_topk & (scaled < kth), -jnp.inf, scaled)
+    # One descending argsort serves both filters.  order[b, r] = token id with
+    # rank r; rank[b, t] = rank of token t.
+    order = jnp.argsort(-scaled, axis=-1)
+    sorted_vals = jnp.take_along_axis(scaled, order, axis=-1)
+    rows = jnp.arange(B, dtype=jnp.int32)[:, None]
+    rank = jnp.zeros((B, V), jnp.int32).at[rows, order].set(
+        jnp.broadcast_to(jnp.arange(V, dtype=jnp.int32)[None, :], (B, V))
+    )
 
-    # --- top-p (nucleus): keep smallest prefix of the sorted distribution with
-    # cumulative prob >= top_p; implemented via the sorted cumulative mass ---
-    sorted_desc2 = jnp.sort(scaled, axis=-1)[:, ::-1]
-    probs_sorted = jax.nn.softmax(sorted_desc2, axis=-1)
-    cum = jnp.cumsum(probs_sorted, axis=-1)
-    # Keep entries where the cumulative mass *before* them is < top_p.
-    keep_sorted = (cum - probs_sorted) < top_p[:, None]
-    # Threshold logit = smallest kept sorted logit.
-    thresh = jnp.min(jnp.where(keep_sorted, sorted_desc2, jnp.inf), axis=-1)
-    use_topp = (top_p < 1.0)[:, None]
-    scaled = jnp.where(use_topp & (scaled < thresh[:, None]), -jnp.inf, scaled)
+    # top-k: keep ranks < k (k <= 0 disables).
+    k = jnp.where(top_k > 0, jnp.clip(top_k, 1, V), V)[:, None]
 
-    sampled = jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
+    # top-p over the top-k-filtered distribution: keep the smallest rank
+    # prefix whose cumulative mass reaches top_p (always >= 1 token).
+    sorted_masked = jnp.where(
+        jnp.arange(V, dtype=jnp.int32)[None, :] < k, sorted_vals, -jnp.inf
+    )
+    probs_sorted = jax.nn.softmax(sorted_masked, axis=-1)
+    cum_before = jnp.cumsum(probs_sorted, axis=-1) - probs_sorted
+    n_keep = jnp.sum(cum_before < top_p[:, None], axis=-1, dtype=jnp.int32)
+    n_keep = jnp.where(top_p < 1.0, jnp.maximum(n_keep, 1), V)[:, None]
+
+    keep = rank < jnp.minimum(k, n_keep)
+    filtered = jnp.where(keep, scaled, -jnp.inf)
+
+    sampled = jax.random.categorical(rng, filtered, axis=-1).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy, sampled)

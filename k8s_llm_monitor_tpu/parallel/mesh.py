@@ -49,5 +49,17 @@ def create_mesh(
         cfg = MeshConfig(model=len(devices))
     if cfg.size != len(devices):
         raise ValueError(f"mesh {cfg} needs {cfg.size} devices, have {len(devices)}")
-    arr = np.asarray(devices).reshape(cfg.data, cfg.seq, cfg.model)
+    shape = (cfg.data, cfg.seq, cfg.model)
+    try:
+        # mesh_utils understands the physical ICI topology (2D/3D torus on
+        # TPU) and orders devices so the innermost mesh axis lands on
+        # nearest-neighbor links; a naive reshape of jax.devices() does NOT
+        # guarantee that beyond 1D.
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_device_mesh(shape, devices=devices)
+    except (ValueError, AssertionError, NotImplementedError):
+        # Virtual CPU meshes and odd single-host layouts fall back to
+        # enumeration order, which is fine off-hardware.
+        arr = np.asarray(devices).reshape(shape)
     return Mesh(arr, AXES)

@@ -8,10 +8,14 @@ Megatron-style tensor parallelism expressed as GSPMD annotations:
 XLA inserts the psum after row-parallel matmuls automatically from these
 annotations — there is no manual collective in the model code.
 
-KV pages shard the kv-heads axis over ``model``.  For Llama-3-8B (8 KV heads)
-on v5e-8 that is exactly one KV head per chip; for TP degrees beyond the KV
-head count, GSPMD replicates within groups (acceptable: 70B-class keeps
-TP <= 16 with 8 KV heads and XLA handles the partial replication).
+KV pages shard the kv-heads axis over ``model`` when the head count divides
+the TP degree.  For Llama-3-8B (8 KV heads) on v5e-8 that is exactly one KV
+head per chip.  When TP exceeds the KV head count (70B/72B: 8 KV heads on
+v5p-16), the kv-heads axis cannot be partitioned 16 ways — those configs
+replicate the KV pages across the model axis instead — ``kv_pages_partition_
+specs`` infers the choice from the pages' kv-heads axis and the mesh's
+``model`` axis size — trading HBM for a spec that compiles; attention
+Q-heads remain fully sharded either way.
 """
 
 from __future__ import annotations
@@ -51,9 +55,21 @@ def param_partition_specs(params: Any) -> Any:
     return jax.tree_util.tree_map_with_path(lambda p, _: _spec_for_path(p), params)
 
 
-def kv_pages_partition_specs(pages: KVPages) -> KVPages:
-    """[num_blocks, block_size, kv_heads, head_dim] -> shard kv_heads."""
-    spec = P(None, None, "model", None)
+def kv_pages_partition_specs(
+    pages: KVPages, mesh: Mesh | None = None
+) -> KVPages:
+    """[num_blocks, block_size, kv_heads, head_dim] -> shard kv_heads.
+
+    When the mesh's ``model`` axis is larger than the kv-heads axis (TP >
+    num_kv_heads, e.g. 8-KV-head 70B on v5p-16), partitioning kv_heads would
+    not divide evenly and jit/device_put fail — replicate the pages instead.
+    """
+    num_kv_heads = pages.k[0].shape[2]
+    tp = mesh.shape["model"] if mesh is not None else 1
+    if mesh is not None and (tp > num_kv_heads or num_kv_heads % tp != 0):
+        spec = P(None, None, None, None)
+    else:
+        spec = P(None, None, "model", None)
     return KVPages(
         k=[spec for _ in pages.k],
         v=[spec for _ in pages.v],

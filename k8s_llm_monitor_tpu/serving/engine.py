@@ -30,7 +30,7 @@ import numpy as np
 
 from k8s_llm_monitor_tpu.models import llama
 from k8s_llm_monitor_tpu.models.config import ModelConfig
-from k8s_llm_monitor_tpu.ops.sampling import sample_tokens
+from k8s_llm_monitor_tpu.ops.sampling import greedy_tokens, sample_tokens
 from k8s_llm_monitor_tpu.serving.kv_cache import BlockAllocator, OutOfBlocks
 
 
@@ -58,9 +58,10 @@ class GenerationRequest:
 class GenerationResult:
     request_id: str
     token_ids: list[int]
-    finish_reason: str         # "eos" | "length"
+    finish_reason: str         # "eos" | "length" | "error"
     ttft_s: float              # submit -> first token
     latency_s: float           # submit -> completion
+    error: str = ""            # set when finish_reason == "error"
 
 
 @dataclasses.dataclass
@@ -127,7 +128,7 @@ class InferenceEngine:
                 lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
                 params, pspecs,
             )
-            kvspecs = kv_pages_partition_specs(pages)
+            kvspecs = kv_pages_partition_specs(pages, mesh)
             pages = llama.KVPages(
                 k=[jax.device_put(x, NamedSharding(mesh, s))
                    for x, s in zip(pages.k, kvspecs.k)],
@@ -145,6 +146,11 @@ class InferenceEngine:
         def _prefill_fn(params, tokens, lengths, pages, tables):
             return llama.prefill(params, cfg, tokens, lengths, pages, tables)
 
+        def _prefill_chunk_fn(params, tokens, start, lengths, pages, tables):
+            return llama.prefill_chunk(
+                params, cfg, tokens, start, lengths, pages, tables
+            )
+
         def _decode_fn(params, tokens, ctx, pages, tables, temp, topk, topp, rng):
             logits, pages = llama.decode_step(
                 params, cfg, tokens, ctx, pages, tables, attn_impl=attn_impl
@@ -152,9 +158,20 @@ class InferenceEngine:
             nxt = sample_tokens(rng, logits, temperature=temp, top_k=topk, top_p=topp)
             return nxt, pages
 
+        def _decode_greedy_fn(params, tokens, ctx, pages, tables):
+            # Sort-free fast path for all-greedy steps (the common diagnosis
+            # workload: temperature 0) — skips the [B, V] argsort + rank
+            # scatter sample_tokens needs for nucleus filtering.
+            logits, pages = llama.decode_step(
+                params, cfg, tokens, ctx, pages, tables, attn_impl=attn_impl
+            )
+            return greedy_tokens(logits), pages
+
         # pages are donated so the scatter-updates happen in place on device.
         self._prefill = jax.jit(_prefill_fn, donate_argnums=(3,))
+        self._prefill_chunk = jax.jit(_prefill_chunk_fn, donate_argnums=(4,))
         self._decode = jax.jit(_decode_fn, donate_argnums=(3,))
+        self._decode_greedy = jax.jit(_decode_greedy_fn, donate_argnums=(3,))
         self._sample = jax.jit(
             lambda rng, logits, t, k, p: sample_tokens(
                 rng, logits, temperature=t, top_k=k, top_p=p
@@ -173,13 +190,36 @@ class InferenceEngine:
     # public API
     # ------------------------------------------------------------------
 
+    @property
+    def capacity_tokens(self) -> int:
+        """Max cached tokens for one sequence (per-seq table cap and pool)."""
+        ec = self.ecfg
+        return min(ec.max_blocks_per_seq, ec.num_blocks - 1) * ec.block_size
+
+    def _cap_request(self, req: GenerationRequest) -> None:
+        """Enforce prompt_len + max_tokens <= capacity (reference ADVICE:
+        submit-time truncation prevents the block-table overflow crash and
+        the can_alloc livelock).  Keeps the prompt *tail* — diagnosis prompts
+        front-load boilerplate — and never produces a degenerate slice."""
+        cap = self.capacity_tokens
+        sp = req.sampling
+        if sp.max_tokens >= cap:
+            req.sampling = dataclasses.replace(sp, max_tokens=cap - 1)
+            sp = req.sampling
+        overflow = len(req.prompt_ids) + sp.max_tokens - cap
+        if overflow > 0:
+            req.prompt_ids = req.prompt_ids[overflow:]
+            if req.orig_prompt_len >= 0:
+                # Preempted fold being re-capped: the dropped tokens come off
+                # the original-prompt prefix, not the generated tail.
+                req.orig_prompt_len = max(0, req.orig_prompt_len - overflow)
+
     def submit(self, req: GenerationRequest) -> None:
         if not req.prompt_ids:
             raise ValueError("empty prompt")
-        max_len = self.ecfg.max_blocks_per_seq * self.ecfg.block_size
-        if len(req.prompt_ids) >= max_len:
-            # keep the tail — diagnosis prompts front-load boilerplate
-            req.prompt_ids = req.prompt_ids[-(max_len - req.sampling.max_tokens - 1):]
+        if req.sampling.max_tokens < 1:
+            raise ValueError("max_tokens must be >= 1")
+        self._cap_request(req)
         self._pending.append(req)
 
     def submit_text(self, request_id: str, prompt: str,
@@ -232,10 +272,18 @@ class InferenceEngine:
     # -- admission ------------------------------------------------------
 
     def _bucket(self, n: int) -> int:
+        """Smallest prefill bucket covering ``n`` tokens.
+
+        ``n`` must not exceed the largest bucket — longer prompts go through
+        chunked prefill (``_try_admit`` splits them), never silent clamping.
+        """
         for b in self.ecfg.prefill_buckets:
             if n <= b:
                 return b
-        return self.ecfg.prefill_buckets[-1]
+        raise ValueError(
+            f"{n} tokens exceeds the largest prefill bucket "
+            f"{self.ecfg.prefill_buckets[-1]} — chunk before bucketing"
+        )
 
     def _free_slot(self) -> Optional[int]:
         for i, s in enumerate(self._slots):
@@ -243,12 +291,31 @@ class InferenceEngine:
                 return i
         return None
 
+    def _fail_request(self, req: GenerationRequest, msg: str) -> None:
+        now = time.monotonic()
+        self._results[req.request_id] = GenerationResult(
+            request_id=req.request_id,
+            token_ids=req.prompt_ids[req.orig_prompt_len:]
+            if req.orig_prompt_len >= 0 else [],
+            finish_reason="error",
+            ttft_s=0.0,
+            latency_s=now - req.submit_time,
+            error=msg,
+        )
+
     def _try_admit(self) -> bool:
         slot_idx = self._free_slot()
         if slot_idx is None:
             return False
         req = self._pending[0]
         L = len(req.prompt_ids)
+        if L + 1 > self.capacity_tokens:
+            # Defensive: submit() caps requests, so this only catches internal
+            # misuse; fail loudly instead of livelocking in can_alloc forever.
+            self._pending.popleft()
+            self._fail_request(req, f"prompt of {L} tokens exceeds capacity "
+                                    f"{self.capacity_tokens}")
+            return True
         if not self.allocator.can_alloc(L + 1):
             return False
         self._pending.popleft()
@@ -256,16 +323,34 @@ class InferenceEngine:
             req.orig_prompt_len = L
         blocks = self.allocator.alloc(L + 1)
 
-        bucket = self._bucket(L)
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :L] = req.prompt_ids
         table = np.zeros((1, self.ecfg.max_blocks_per_seq), np.int32)
         table[0, : len(blocks)] = blocks
+        table_j = jnp.asarray(table)
 
+        # Chunked prefill: prompts longer than the largest bucket are split;
+        # the first chunk runs the dense path, continuations attend to the
+        # paged prefix (llama.prefill_chunk).
+        top = self.ecfg.prefill_buckets[-1]
+        first = min(L, top)
+        bucket = self._bucket(first)
+        tokens = np.zeros((1, bucket), np.int32)
+        tokens[0, :first] = req.prompt_ids[:first]
         logits, self.pages = self._prefill(
             self.params, jnp.asarray(tokens),
-            jnp.asarray([L], jnp.int32), self.pages, jnp.asarray(table),
+            jnp.asarray([first], jnp.int32), self.pages, table_j,
         )
+        pos = first
+        while pos < L:
+            n = min(L - pos, top)
+            bucket = self._bucket(n)
+            tokens = np.zeros((1, bucket), np.int32)
+            tokens[0, :n] = req.prompt_ids[pos:pos + n]
+            logits, self.pages = self._prefill_chunk(
+                self.params, jnp.asarray(tokens),
+                jnp.asarray([pos], jnp.int32), jnp.asarray([n], jnp.int32),
+                self.pages, table_j,
+            )
+            pos += n
         self.prefills += 1
 
         sp = req.sampling
@@ -302,17 +387,34 @@ class InferenceEngine:
         topk = np.zeros((B,), np.int32)
         topp = np.ones((B,), np.float32)
 
-        # Ensure every active slot has a page for the incoming token; evict
-        # youngest-first on pressure.
+        # Ensure every active slot has a page for the incoming token.  On
+        # pressure, evict the *youngest* active slot (recompute-preemption)
+        # so the oldest requests always make progress — guarantees the loop
+        # drains even when the pool is smaller than the working set.  The
+        # youngest slot may be the one that failed, in which case it evicts
+        # itself rather than stealing pages from an older request.
+        def _youngest_active() -> int:
+            return max(
+                (j for j, sl in enumerate(self._slots) if sl is not None),
+                key=lambda j: self._slots[j].req.submit_time,
+            )
+
         for i in sorted(
             (i for i, s in enumerate(self._slots) if s is not None),
             key=lambda i: self._slots[i].req.submit_time,
         ):
             s = self._slots[i]
-            try:
-                self.allocator.extend(s.blocks, s.ctx_len + 1)
-            except OutOfBlocks:
-                self._preempt(i)
+            if s is None:  # already evicted below
+                continue
+            while True:
+                try:
+                    self.allocator.extend(s.blocks, s.ctx_len + 1)
+                    break
+                except OutOfBlocks:
+                    victim = _youngest_active()
+                    self._preempt(victim)
+                    if victim == i:
+                        break
 
         active = [(i, s) for i, s in enumerate(self._slots) if s is not None]
         if not active:
@@ -324,12 +426,18 @@ class InferenceEngine:
             sp = s.req.sampling
             temp[i], topk[i], topp[i] = sp.temperature, sp.top_k, sp.top_p
 
-        self._rng, sub = jax.random.split(self._rng)
-        nxt, self.pages = self._decode(
-            self.params, jnp.asarray(tokens), jnp.asarray(ctx), self.pages,
-            jnp.asarray(table), jnp.asarray(temp), jnp.asarray(topk),
-            jnp.asarray(topp), sub,
-        )
+        if all(s.req.sampling.temperature <= 0.0 for _, s in active):
+            nxt, self.pages = self._decode_greedy(
+                self.params, jnp.asarray(tokens), jnp.asarray(ctx),
+                self.pages, jnp.asarray(table),
+            )
+        else:
+            self._rng, sub = jax.random.split(self._rng)
+            nxt, self.pages = self._decode(
+                self.params, jnp.asarray(tokens), jnp.asarray(ctx), self.pages,
+                jnp.asarray(table), jnp.asarray(temp), jnp.asarray(topk),
+                jnp.asarray(topp), sub,
+            )
         nxt = np.asarray(nxt)
 
         for i, s in active:
@@ -376,5 +484,6 @@ class InferenceEngine:
         req.sampling = dataclasses.replace(
             req.sampling, max_tokens=max(1, req.sampling.max_tokens - consumed)
         )
+        self._cap_request(req)  # re-apply the submit-time capacity cap
         self._pending.appendleft(req)
         self.preemptions += 1

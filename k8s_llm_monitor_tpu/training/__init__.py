@@ -1,0 +1,16 @@
+"""Training: pjit/GSPMD train step for the decoder LM family.
+
+The reference has no training of any kind (its LLM layer is config keys,
+reference internal/config/config.go:141-145); this package exists for the
+north-star obligation of a complete TPU framework — fine-tuning the
+diagnosis model on cluster-incident transcripts runs through the same
+sharded forward as serving.
+"""
+
+from k8s_llm_monitor_tpu.training.train import (  # noqa: F401
+    TrainConfig,
+    TrainState,
+    create_train_state,
+    make_train_step,
+    shard_train_state,
+)

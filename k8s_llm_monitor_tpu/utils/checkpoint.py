@@ -36,6 +36,7 @@ def config_from_hf(hf: Mapping[str, Any], name: str = "hf-model") -> ModelConfig
         num_kv_heads=hf.get("num_key_value_heads", num_heads),
         head_dim=hf.get("head_dim"),
         rope_theta=hf.get("rope_theta", 10_000.0),
+        rope_scaling=hf.get("rope_scaling"),
         rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
         max_seq_len=hf.get("max_position_embeddings", 8192),
         qkv_bias=hf.get("model_type") == "qwen2",

@@ -17,6 +17,16 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# The environment's sitecustomize (a .pth hook on PYTHONPATH) registers the
+# tunneled-TPU PJRT plugin at interpreter startup and calls
+# jax.config.update("jax_platforms", "axon,cpu"), which OVERRIDES the env var
+# above — a plain `pytest` would then run every test against the single real
+# chip over the tunnel (slow enough to look like a hang, and test_sharding
+# needs 8 devices).  Re-pin the config here, before any backend initializes.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
 import pytest  # noqa: E402
 
 

@@ -84,6 +84,36 @@ def test_preemption_under_page_pressure(params):
     assert eng.preemptions > 0, "test did not actually exercise preemption"
 
 
+def test_chunked_prefill_long_prompt(params):
+    """Prompts longer than the largest bucket split into chunks; the
+    continuation chunks attend to the paged prefix and must match naive."""
+    eng = InferenceEngine(
+        CFG, params,
+        EngineConfig(max_slots=2, num_blocks=64, block_size=8,
+                     max_blocks_per_seq=16, prefill_buckets=(16,)),
+        eos_id=-1,
+    )
+    rng = np.random.default_rng(5)
+    prompt = list(rng.integers(3, 300, size=45))  # 45 > 16 -> 3 chunks
+    [r] = eng.generate([prompt], SamplingParams(max_tokens=6))
+    assert r.token_ids == _naive_greedy(params, prompt, 6)
+
+
+def test_oversized_prompt_truncates_to_tail(params):
+    """Prompt + budget beyond cache capacity keeps the prompt *tail*."""
+    eng = InferenceEngine(
+        CFG, params,
+        EngineConfig(max_slots=2, num_blocks=64, block_size=8,
+                     max_blocks_per_seq=16, prefill_buckets=(16, 32, 64, 128)),
+        eos_id=-1,
+    )
+    rng = np.random.default_rng(6)
+    huge = list(rng.integers(3, 300, size=400))   # capacity is 128
+    [r] = eng.generate([huge], SamplingParams(max_tokens=10))
+    assert r.finish_reason == "length"
+    assert r.token_ids == _naive_greedy(params, huge[-(128 - 10):], 10)
+
+
 def test_eos_stops_generation(params):
     eng = InferenceEngine(
         CFG, params,
@@ -93,11 +123,13 @@ def test_eos_stops_generation(params):
     )
     prompt = list(range(3, 10))
     free = _naive_greedy(params, prompt, 20)
-    eos = free[4]  # pretend the 5th generated token is EOS
-    eng.eos_id = eos
+    # Pick an EOS token at its *first* occurrence in the stream — choosing a
+    # token that repeats earlier would legitimately stop generation early.
+    idx = next(i for i in range(3, len(free)) if free[i] not in free[:i])
+    eng.eos_id = free[idx]
     [r] = eng.generate([prompt], SamplingParams(max_tokens=20))
     assert r.finish_reason == "eos"
-    assert r.token_ids == free[:4]
+    assert r.token_ids == free[:idx]
 
 
 def test_sampling_with_seed_is_reproducible(params):
