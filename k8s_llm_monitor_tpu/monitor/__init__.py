@@ -1,0 +1,35 @@
+"""Kubernetes intelligent-monitoring control plane.
+
+This package is the product layer of the framework: the cluster-facing
+monitoring capability set of the reference (config, cluster access, watchers,
+metrics collection, network diagnosis, UAV telemetry, scheduling) plus the
+Analysis Engine the reference only sketched, wired to the in-tree TPU
+inference stack (``k8s_llm_monitor_tpu.serving``).
+
+Module map (reference parity cited per module):
+
+- ``config``        — typed config tree + YAML/env loader
+                      (ref internal/config/config.go)
+- ``models``        — cluster data models / JSON contract
+                      (ref pkg/models/models.go, pkg/models/scheduler.go)
+- ``metrics_types`` — metrics data models (ref pkg/metrics/types.go)
+- ``cluster``       — ClusterBackend seam + FakeCluster in-memory backend
+- ``client``        — high-level cluster client (ref internal/k8s/client.go)
+- ``watcher``       — reconnecting resource/CRD watchers
+                      (ref internal/k8s/watcher.go, crd_watcher.go)
+- ``rtt``           — in-pod exec RTT probes (ref internal/k8s/rtt_tester.go)
+- ``network``       — pod-communication analyzer (ref internal/k8s/network.go)
+- ``sources``       — node/pod/network/UAV metric sources
+                      (ref internal/metrics/sources/)
+- ``manager``       — snapshot collection loop (ref internal/metrics/manager.go)
+- ``uav``           — MAVLink telemetry simulator (ref pkg/uav/)
+- ``agent``         — per-node UAV agent (ref cmd/uav-agent/main.go)
+- ``scheduler``     — UAV-aware scheduling controller
+                      (ref internal/scheduler/controller.go)
+- ``analysis``      — the Analysis Engine: evidence assembly + TPU LLM backends
+- ``server``        — the HTTP JSON API (ref cmd/server/main.go)
+"""
+
+from k8s_llm_monitor_tpu.monitor.config import Config, load_config
+
+__all__ = ["Config", "load_config"]

@@ -1,0 +1,395 @@
+"""High-level cluster client + wire-shape converters.
+
+Parity target: ``/root/reference/internal/k8s/client.go:35-480`` (read
+APIs, CRD upsert) and ``internal/k8s/converter.go:13-111`` (raw object →
+model conversion, incl. the non-secret env extraction at converter.go:37-41
+and container-state naming at :85-111). The client is backend-agnostic:
+pass a ``FakeCluster`` for tests/dev mode or a ``KubeRestBackend`` for a
+real cluster.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any
+
+from k8s_llm_monitor_tpu.monitor.cluster import (
+    ClusterBackend,
+    ClusterError,
+    NotFound,
+    WatchStream,
+)
+from k8s_llm_monitor_tpu.monitor.models import (
+    ContainerInfo,
+    CustomResourceInfo,
+    EventInfo,
+    NetworkPolicyInfo,
+    NetworkPolicyRule,
+    PeerRule,
+    PodInfo,
+    PortRule,
+    ServiceInfo,
+    ServicePort,
+    UAVReport,
+    parse_rfc3339,
+    rfc3339,
+    utcnow,
+)
+
+logger = logging.getLogger("monitor.client")
+
+UAV_METRICS_GVR = ("monitoring.io", "v1", "uavmetrics")
+SCHEDULING_GVR = ("scheduler.io", "v1", "schedulingrequests")
+
+
+# ---------------------------------------------------------------------------
+# converters (ref internal/k8s/converter.go)
+# ---------------------------------------------------------------------------
+
+
+def container_state_name(status: dict[str, Any]) -> str:
+    """running/waiting:<reason>/terminated:<reason> (ref converter.go:85-111)."""
+    state = status.get("state", {})
+    if "running" in state:
+        return "running"
+    if "waiting" in state:
+        reason = state["waiting"].get("reason", "")
+        return f"waiting:{reason}" if reason else "waiting"
+    if "terminated" in state:
+        reason = state["terminated"].get("reason", "")
+        return f"terminated:{reason}" if reason else "terminated"
+    return "unknown"
+
+
+_SECRET_HINTS = ("PASSWORD", "SECRET", "TOKEN", "KEY", "CREDENTIAL")
+
+
+def convert_pod(raw: dict[str, Any]) -> PodInfo:
+    md = raw.get("metadata", {})
+    spec = raw.get("spec", {})
+    status = raw.get("status", {})
+    statuses = {s.get("name"): s for s in status.get("containerStatuses", [])}
+    containers = []
+    for c in spec.get("containers", []):
+        st = statuses.get(c.get("name"), {})
+        env = {}
+        for e in c.get("env", []):
+            name = e.get("name", "")
+            # skip secret-looking and valueFrom-only env (ref converter.go:37-41)
+            if "value" not in e:
+                continue
+            if any(h in name.upper() for h in _SECRET_HINTS):
+                continue
+            env[name] = e.get("value", "")
+        containers.append(
+            ContainerInfo(
+                name=c.get("name", ""),
+                image=c.get("image", ""),
+                state=container_state_name(st),
+                ready=bool(st.get("ready", False)),
+                env=env,
+            )
+        )
+    return PodInfo(
+        name=md.get("name", ""),
+        namespace=md.get("namespace", ""),
+        status=status.get("phase", ""),
+        node_name=spec.get("nodeName", ""),
+        ip=status.get("podIP", ""),
+        labels=dict(md.get("labels", {}) or {}),
+        start_time=parse_rfc3339(status.get("startTime")) or utcnow(),
+        containers=containers,
+    )
+
+
+def convert_service(raw: dict[str, Any]) -> ServiceInfo:
+    md = raw.get("metadata", {})
+    spec = raw.get("spec", {})
+    return ServiceInfo(
+        name=md.get("name", ""),
+        namespace=md.get("namespace", ""),
+        type=spec.get("type", "ClusterIP"),
+        cluster_ip=spec.get("clusterIP", ""),
+        ports=[
+            ServicePort(
+                name=p.get("name", ""),
+                port=int(p.get("port", 0)),
+                protocol=p.get("protocol", "TCP"),
+            )
+            for p in spec.get("ports", [])
+        ],
+        selector=dict(spec.get("selector", {}) or {}),
+    )
+
+
+def convert_event(raw: dict[str, Any]) -> EventInfo:
+    ts = (
+        raw.get("lastTimestamp")
+        or raw.get("eventTime")
+        or raw.get("metadata", {}).get("creationTimestamp")
+    )
+    return EventInfo(
+        type=raw.get("type", ""),
+        reason=raw.get("reason", ""),
+        message=raw.get("message", ""),
+        source=raw.get("source", {}).get("component", ""),
+        timestamp=parse_rfc3339(ts) or utcnow(),
+        count=int(raw.get("count", 1) or 1),
+    )
+
+
+def convert_network_policy(raw: dict[str, Any]) -> NetworkPolicyInfo:
+    md = raw.get("metadata", {})
+    spec = raw.get("spec", {})
+
+    def peers(items: list[dict]) -> list[PeerRule]:
+        return [
+            PeerRule(
+                pod_selector=dict(
+                    (p.get("podSelector") or {}).get("matchLabels", {}) or {}
+                ),
+                namespace_selector=dict(
+                    (p.get("namespaceSelector") or {}).get("matchLabels", {}) or {}
+                ),
+            )
+            for p in items
+        ]
+
+    def rules(items: list[dict], peer_key: str) -> list[NetworkPolicyRule]:
+        out = []
+        for r in items or []:
+            rule = NetworkPolicyRule(
+                ports=[
+                    PortRule(
+                        protocol=p.get("protocol", "TCP"), port=int(p.get("port", 0))
+                    )
+                    for p in r.get("ports", [])
+                ]
+            )
+            if peer_key == "from":
+                rule.from_ = peers(r.get("from", []))
+            else:
+                rule.to = peers(r.get("to", []))
+            out.append(rule)
+        return out
+
+    return NetworkPolicyInfo(
+        name=md.get("name", ""),
+        namespace=md.get("namespace", ""),
+        pod_selector=dict(
+            (spec.get("podSelector") or {}).get("matchLabels", {}) or {}
+        ),
+        ingress=rules(spec.get("ingress", []), "from"),
+        egress=rules(spec.get("egress", []), "to"),
+    )
+
+
+def convert_custom_resource(
+    raw: dict[str, Any], group: str, kind: str
+) -> CustomResourceInfo:
+    """ref client.go convertUnstructuredToModel + getLastUpdateTime."""
+    md = raw.get("metadata", {})
+    managed = md.get("managedFields") or []
+    update_ts = None
+    if managed and managed[0].get("time"):
+        update_ts = parse_rfc3339(managed[0]["time"])
+    creation = parse_rfc3339(md.get("creationTimestamp")) or utcnow()
+    return CustomResourceInfo(
+        kind=kind,
+        name=md.get("name", ""),
+        namespace=md.get("namespace", ""),
+        group=group,
+        version=raw.get("apiVersion", ""),
+        spec=dict(raw.get("spec", {}) or {}),
+        status=dict(raw.get("status", {}) or {}),
+        generation=int(md.get("generation", 0) or 0),
+        creation_time=creation,
+        update_time=update_ts or creation,
+    )
+
+
+def sanitize_resource_name(name: str) -> str:
+    """ref client.go:452-461."""
+    name = name.lower().replace("_", "-").replace(".", "-").strip()
+    return name or "unknown"
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+
+class Client:
+    """Cluster client over a ``ClusterBackend``.
+
+    Mirrors the reference Client's read API (client.go:103-247) and the
+    UAVMetric CRD surface (client.go:255-450).
+    """
+
+    def __init__(
+        self,
+        backend: ClusterBackend,
+        namespaces: list[str] | None = None,
+        default_namespace: str = "default",
+    ) -> None:
+        self.backend = backend
+        self._namespaces = list(namespaces or [default_namespace])
+        self.default_namespace = default_namespace
+
+    # -- basic reads ---------------------------------------------------------
+
+    def namespaces(self) -> list[str]:
+        return list(self._namespaces)
+
+    def test_connection(self) -> str:
+        version = self.backend.server_version()
+        logger.info("Connected to Kubernetes cluster: %s", version)
+        return version
+
+    def get_cluster_info(self) -> dict[str, Any]:
+        """ref client.go:115-150 — version, node count, pod count, namespaces."""
+        version = self.backend.server_version()
+        nodes = self.backend.list_nodes()
+        pod_count = 0
+        for ns in self._namespaces:
+            try:
+                pod_count += len(self.backend.list_pods(ns))
+            except ClusterError as exc:
+                logger.warning("Failed to list pods in namespace %s: %s", ns, exc)
+        return {
+            "version": version,
+            "nodes": len(nodes),
+            "pods": pod_count,
+            "namespaces": list(self._namespaces),
+        }
+
+    def get_pods(self, namespace: str) -> list[PodInfo]:
+        return [convert_pod(p) for p in self.backend.list_pods(namespace)]
+
+    def get_pod(self, namespace: str, name: str) -> PodInfo:
+        for p in self.backend.list_pods(namespace):
+            if p.get("metadata", {}).get("name") == name:
+                return convert_pod(p)
+        raise NotFound(f"pod {namespace}/{name} not found")
+
+    def get_services(self, namespace: str) -> list[ServiceInfo]:
+        return [convert_service(s) for s in self.backend.list_services(namespace)]
+
+    def get_events(self, namespace: str, limit: int = 50) -> list[EventInfo]:
+        return [
+            convert_event(e) for e in self.backend.list_events(namespace, limit=limit)
+        ]
+
+    def get_network_policies(self, namespace: str) -> list[NetworkPolicyInfo]:
+        return [
+            convert_network_policy(p)
+            for p in self.backend.list_network_policies(namespace)
+        ]
+
+    def get_pod_logs(self, namespace: str, name: str, tail_lines: int = 100) -> str:
+        return self.backend.pod_logs(namespace, name, tail_lines=tail_lines)
+
+    def exec_in_pod(
+        self, namespace: str, pod: str, command: list[str], timeout: float = 10.0
+    ) -> tuple[str, str, int]:
+        return self.backend.exec_in_pod(namespace, pod, command, timeout=timeout)
+
+    # -- UAVMetric CRD surface (ref client.go:255-450) ------------------------
+
+    def list_uav_metrics_crd(self, namespace: str = "") -> list[CustomResourceInfo]:
+        group, version, plural = UAV_METRICS_GVR
+        items = self.backend.list_custom_resources(
+            group, version, plural, namespace or None
+        )
+        return [convert_custom_resource(o, group, "UAVMetric") for o in items]
+
+    def upsert_uav_metric(self, namespace: str, report: UAVReport) -> None:
+        """Get-then-create-or-update of ``uavmetric-<node>``.
+
+        Spec/status/label layout matches ref client.go:316-450 so the CRD
+        contract (and the scheduler reading it) is wire-compatible.
+        """
+        if report is None:
+            raise ValueError("uav report is None")
+        if not report.node_name:
+            raise ValueError("uav report missing node name")
+        namespace = namespace or self.default_namespace
+        group, version, plural = UAV_METRICS_GVR
+        name = f"uavmetric-{sanitize_resource_name(report.node_name)}"
+
+        spec: dict[str, Any] = {
+            "node_name": report.node_name,
+            "uav_id": report.uav_id,
+        }
+        state = report.state
+        if state is not None:
+            get = (
+                (lambda blk, k, d=0: getattr(getattr(state, blk), k, d))
+                if not isinstance(state, dict)
+                else (lambda blk, k, d=0: (state.get(blk) or {}).get(k, d))
+            )
+            spec["gps"] = {
+                "latitude": get("gps", "latitude", 0.0),
+                "longitude": get("gps", "longitude", 0.0),
+                "altitude": get("gps", "altitude", 0.0),
+                "relative_altitude": get("gps", "relative_altitude", 0.0),
+                "satellite_count": get("gps", "satellite_count", 0),
+                "fix_type": get("gps", "fix_type", 0),
+            }
+            spec["battery"] = {
+                "voltage": get("battery", "voltage", 0.0),
+                "remaining_percent": get("battery", "remaining_percent", 0.0),
+                "remaining_capacity": get("battery", "remaining_capacity", 0.0),
+                "temperature": get("battery", "temperature", 0.0),
+            }
+            spec["flight"] = {
+                "mode": get("flight", "mode", ""),
+                "armed": get("flight", "armed", False),
+                "ground_speed": get("flight", "ground_speed", 0.0),
+                "vertical_speed": get("flight", "vertical_speed", 0.0),
+            }
+            spec["health"] = {
+                "system_status": get("health", "system_status", ""),
+                "error_count": get("health", "error_count", 0),
+                "warning_count": get("health", "warning_count", 0),
+            }
+
+        status_payload = {
+            "last_update": rfc3339(report.timestamp or utcnow()),
+            "collection_status": report.status or "active",
+        }
+        labels: dict[str, Any] = {
+            "app": "uav-agent",
+            "monitoring.io/component": "uav-metrics",
+            "monitoring.io/node": sanitize_resource_name(report.node_name),
+        }
+        if report.uav_id:
+            labels["monitoring.io/uav-id"] = sanitize_resource_name(report.uav_id)
+        if report.node_ip:
+            labels["monitoring.io/node-ip"] = report.node_ip
+
+        body = {
+            "apiVersion": "monitoring.io/v1",
+            "kind": "UAVMetric",
+            "metadata": {"name": name, "namespace": namespace, "labels": labels},
+            "spec": spec,
+            "status": status_payload,
+        }
+        try:
+            existing = self.backend.get_custom_resource(
+                group, version, plural, namespace, name
+            )
+        except NotFound:
+            self.backend.create_custom_resource(group, version, plural, namespace, body)
+            return
+        existing["spec"] = spec
+        existing["status"] = status_payload
+        merged = dict(existing.get("metadata", {}).get("labels", {}) or {})
+        merged.update(labels)
+        existing.setdefault("metadata", {})["labels"] = merged
+        self.backend.update_custom_resource(group, version, plural, namespace, existing)
+
+    # -- watch passthrough ---------------------------------------------------
+
+    def watch(self, kind: str, namespace: str) -> WatchStream:
+        return self.backend.watch(kind, namespace)

@@ -1,0 +1,200 @@
+"""Typed configuration tree + YAML/env loader.
+
+Parity target: ``/root/reference/internal/config/config.go`` — same tree
+(server / k8s / llm / storage / monitoring / metrics / analysis / logging,
+config.go:12-102), same defaults (config.go:132-169), same env override
+behavior (viper ``AutomaticEnv`` with ``.``→``_``, config.go:106-113, plus
+the OPENAI_* aliases at config.go:172-182).
+
+Differences by design (TPU-first): ``llm.provider`` gains the in-tree
+``"tpu"`` value (serving the Analysis Engine from the local JAX engine
+instead of a remote OpenAI call) and an ``llm.tpu`` sub-block selecting the
+model preset; the reference's remote-provider fields are kept for the
+OpenAI-compatible fallback path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from dataclasses import dataclass, field
+from typing import Any
+
+import yaml
+
+
+@dataclass
+class ServerConfig:
+    host: str = "0.0.0.0"
+    port: int = 8080
+    debug: bool = False
+
+
+@dataclass
+class K8sConfig:
+    kubeconfig: str = ""
+    namespace: str = "default"
+    watch_namespaces: list[str] = field(default_factory=lambda: ["default"])
+
+
+@dataclass
+class TPULLMConfig:
+    """In-tree TPU inference backend knobs (new; no reference equivalent)."""
+
+    model: str = "llama-1b"  # preset name in models/config.py PRESETS
+    checkpoint: str = ""  # HF checkpoint dir ('' => random-init dev weights)
+    mesh_shape: str = ""  # e.g. "1,1,8" for data,seq,model; '' => single chip
+    max_batch: int = 32
+    kv_blocks: int = 512
+
+
+@dataclass
+class LLMConfig:
+    provider: str = "tpu"  # "tpu" (in-tree) | "openai" | "template"
+    api_key: str = ""
+    base_url: str = ""
+    model: str = "gpt-4"
+    max_tokens: int = 2000
+    temperature: float = 0.1
+    timeout: int = 30
+    tpu: TPULLMConfig = field(default_factory=TPULLMConfig)
+
+
+@dataclass
+class RedisConfig:
+    host: str = "localhost"
+    port: int = 6379
+    password: str = ""
+    db: int = 0
+
+
+@dataclass
+class PostgresConfig:
+    host: str = "localhost"
+    port: int = 5432
+    user: str = ""
+    password: str = ""
+    database: str = ""
+
+
+@dataclass
+class StorageConfig:
+    type: str = "memory"
+    redis: RedisConfig = field(default_factory=RedisConfig)
+    postgres: PostgresConfig = field(default_factory=PostgresConfig)
+
+
+@dataclass
+class MonitoringConfig:
+    metrics_interval: int = 30
+    event_retention: int = 1000
+    log_retention: int = 1000
+
+
+@dataclass
+class MetricsConfig:
+    enabled: bool = True
+    collect_interval: int = 30
+    namespaces: list[str] = field(default_factory=lambda: ["default"])
+    enable_node: bool = True
+    enable_pod: bool = True
+    enable_network: bool = False
+    enable_custom: bool = False
+    cache_retention: int = 300
+    max_pod_pairs: int = 5
+    network_timeout: int = 10
+
+
+@dataclass
+class AnalysisConfig:
+    enable_prediction: bool = False
+    enable_auto_fix: bool = False
+    max_context_events: int = 100
+
+
+@dataclass
+class LoggingConfig:
+    level: str = "info"
+    format: str = "text"
+    output: str = "stdout"
+
+
+@dataclass
+class Config:
+    server: ServerConfig = field(default_factory=ServerConfig)
+    k8s: K8sConfig = field(default_factory=K8sConfig)
+    llm: LLMConfig = field(default_factory=LLMConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+    monitoring: MonitoringConfig = field(default_factory=MonitoringConfig)
+    metrics: MetricsConfig = field(default_factory=MetricsConfig)
+    analysis: AnalysisConfig = field(default_factory=AnalysisConfig)
+    logging: LoggingConfig = field(default_factory=LoggingConfig)
+
+
+def _coerce(value: str, target: Any) -> Any:
+    """Coerce an env-var string to the type of the current field value."""
+    if isinstance(target, bool):
+        return value.strip().lower() in ("1", "true", "yes", "on")
+    if isinstance(target, int):
+        return int(value)
+    if isinstance(target, float):
+        return float(value)
+    if isinstance(target, list):
+        return [v.strip() for v in value.split(",") if v.strip()]
+    return value
+
+
+def _apply_dict(obj: Any, data: dict[str, Any], path: str = "") -> None:
+    """Recursively overlay a parsed-YAML dict onto the dataclass tree."""
+    for key, value in (data or {}).items():
+        norm = str(key).replace("-", "_")
+        if not dataclasses.is_dataclass(obj) or not hasattr(obj, norm):
+            continue  # unknown keys are ignored, like viper
+        current = getattr(obj, norm)
+        if dataclasses.is_dataclass(current) and isinstance(value, dict):
+            _apply_dict(current, value, f"{path}{norm}.")
+        elif value is not None:
+            if isinstance(current, (bool, int, float)) and isinstance(value, str):
+                value = _coerce(value, current)
+            setattr(obj, norm, value)
+
+
+def _apply_env(obj: Any, prefix: str = "") -> None:
+    """Overlay env vars: config path ``a.b.c`` reads ``A_B_C``.
+
+    Mirrors viper AutomaticEnv with the ``.``→``_`` replacer
+    (ref config.go:106-113).
+    """
+    for f in dataclasses.fields(obj):
+        current = getattr(obj, f.name)
+        env_key = (prefix + f.name).upper()
+        if dataclasses.is_dataclass(current):
+            _apply_env(current, prefix + f.name + "_")
+        elif env_key in os.environ:
+            setattr(obj, f.name, _coerce(os.environ[env_key], current))
+
+
+def load_config(path: str | None = None) -> Config:
+    """Load config: defaults ← YAML file ← env vars ← OPENAI_* aliases.
+
+    Precedence and alias behavior match ref config.go:105-182. A missing
+    file is not an error when ``path`` is empty/None (defaults-only boot,
+    the reference's dev mode); an explicit path that doesn't exist raises.
+    """
+    cfg = Config()
+    if path:
+        with open(path) as fh:
+            data = yaml.safe_load(fh) or {}
+        _apply_dict(cfg, data)
+    _apply_env(cfg)
+    # Compatibility aliases (ref config.go:172-182).
+    if os.environ.get("OPENAI_API_KEY"):
+        cfg.llm.api_key = os.environ["OPENAI_API_KEY"]
+    if os.environ.get("OPENAI_BASE_URL"):
+        cfg.llm.base_url = os.environ["OPENAI_BASE_URL"]
+    # Keep metrics namespaces in sync with watch namespaces when only the
+    # k8s block was configured (the reference wires cfg.K8s.WatchNamespaces
+    # into the manager directly, cmd/server/main.go:62-72).
+    if cfg.k8s.watch_namespaces and cfg.metrics.namespaces == ["default"]:
+        cfg.metrics.namespaces = list(cfg.k8s.watch_namespaces)
+    return cfg

@@ -1,0 +1,358 @@
+"""Reconnecting resource watchers + CRD watcher.
+
+Parity target: ``/root/reference/internal/k8s/watcher.go`` (EventHandler
+seam :16-21, per-namespace watch goroutines with reconnect-forever + 5 s
+backoff :42-237; events deliver only Added :222-234) and
+``crd_watcher.go`` (CRD discovery + dynamic per-CRD watches :85-240, CR
+cache :353-383).
+
+Deliberate fixes over the reference (SURVEY §2.4 "do NOT reproduce"):
+- the CR-watch registry and cache are lock-guarded (ref mutates
+  ``crdWatchers`` from multiple goroutines unlocked, crd_watcher.go:26,152);
+- watcher threads are joinable and ``stop()`` actually tears them down
+  (ref never joins its goroutines);
+- the CR watch uses the CRD's storage version (ref builds a GVR with an
+  empty Version, crd_watcher.go:148-151).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Any, Callable
+
+from k8s_llm_monitor_tpu.monitor.client import (
+    Client,
+    convert_event,
+    convert_pod,
+    convert_service,
+)
+from k8s_llm_monitor_tpu.monitor.cluster import ClusterError, WatchStream
+from k8s_llm_monitor_tpu.monitor.models import (
+    CRDEvent,
+    CRDInfo,
+    CustomResourceInfo,
+    EventInfo,
+    PodInfo,
+    ServiceInfo,
+    parse_rfc3339,
+    utcnow,
+)
+
+logger = logging.getLogger("monitor.watcher")
+
+
+class EventHandler:
+    """Fan-out seam for reactive consumers (ref watcher.go:16-21)."""
+
+    def on_pod_update(self, event_type: str, pod: PodInfo) -> None: ...
+
+    def on_service_update(self, event_type: str, service: ServiceInfo) -> None: ...
+
+    def on_event(self, event: EventInfo) -> None: ...
+
+    def on_crd_event(self, event: CRDEvent) -> None: ...
+
+
+class Watcher:
+    """Watches pods/services/events across namespaces with auto-reconnect.
+
+    One thread per (namespace, resource); each runs watch → drain → on
+    stream close, sleep ``reconnect_delay`` and re-watch, forever, until
+    ``stop()``.
+    """
+
+    def __init__(
+        self,
+        client: Client,
+        handler: EventHandler,
+        namespaces: list[str] | None = None,
+        reconnect_delay: float = 5.0,
+    ) -> None:
+        self.client = client
+        self.handler = handler
+        self.namespaces = list(namespaces or client.namespaces())
+        self.reconnect_delay = reconnect_delay
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+        self._streams: list[WatchStream] = []
+        self._lock = threading.Lock()
+
+    def start(self) -> None:
+        for ns in self.namespaces:
+            for kind in ("pods", "services", "events"):
+                t = threading.Thread(
+                    target=self._watch_loop,
+                    args=(kind, ns),
+                    name=f"watch-{kind}-{ns}",
+                    daemon=True,
+                )
+                self._threads.append(t)
+                t.start()
+        logger.info(
+            "watcher started for namespaces %s (%d threads)",
+            self.namespaces,
+            len(self._threads),
+        )
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        with self._lock:
+            streams = list(self._streams)
+        for s in streams:
+            s.close()
+        for t in self._threads:
+            t.join(timeout=timeout)
+        self._threads.clear()
+
+    def _register(self, stream: WatchStream) -> None:
+        # Close immediately if stop() ran between watch() and registration,
+        # otherwise the thread would block forever on an unclosable stream.
+        with self._lock:
+            self._streams.append(stream)
+        if self._stop.is_set():
+            stream.close()
+
+    def _watch_loop(self, kind: str, namespace: str) -> None:
+        while not self._stop.is_set():
+            try:
+                stream = self.client.watch(kind, namespace)
+            except ClusterError as exc:
+                logger.warning("watch %s/%s failed: %s; retrying", kind, namespace, exc)
+                self._stop.wait(self.reconnect_delay)
+                continue
+            self._register(stream)
+            try:
+                for event_type, obj in stream:
+                    if self._stop.is_set():
+                        return
+                    self._dispatch(kind, event_type, obj)
+            except Exception:
+                logger.exception("watch %s/%s dispatch error", kind, namespace)
+            finally:
+                with self._lock:
+                    if stream in self._streams:
+                        self._streams.remove(stream)
+            # stream closed server-side → reconnect (ref watcher.go:84-87)
+            self._stop.wait(self.reconnect_delay)
+
+    def _dispatch(self, kind: str, event_type: str, obj: dict[str, Any]) -> None:
+        if kind == "pods":
+            self.handler.on_pod_update(event_type, convert_pod(obj))
+        elif kind == "services":
+            self.handler.on_service_update(event_type, convert_service(obj))
+        elif kind == "events" and event_type == "ADDED":
+            # only Added, like ref watcher.go:222-234
+            self.handler.on_event(convert_event(obj))
+
+
+def convert_crd(raw: dict[str, Any]) -> CRDInfo:
+    md = raw.get("metadata", {})
+    spec = raw.get("spec", {})
+    names = spec.get("names", {})
+    conds = raw.get("status", {}).get("conditions", [])
+    established = any(
+        c.get("type") == "Established" and c.get("status") == "True" for c in conds
+    )
+    versions = [v.get("name", "") for v in spec.get("versions", [])]
+    stored = any(v.get("storage") for v in spec.get("versions", []))
+    return CRDInfo(
+        name=md.get("name", ""),
+        group=spec.get("group", ""),
+        kind=names.get("kind", ""),
+        scope=spec.get("scope", "Namespaced"),
+        versions=versions,
+        plural=names.get("plural", ""),
+        singular=names.get("singular", ""),
+        established=established,
+        stored=stored,
+        creation_time=parse_rfc3339(md.get("creationTimestamp")) or utcnow(),
+    )
+
+
+def storage_version(raw_crd: dict[str, Any]) -> str:
+    for v in raw_crd.get("spec", {}).get("versions", []):
+        if v.get("storage"):
+            return v.get("name", "v1")
+    versions = raw_crd.get("spec", {}).get("versions", [])
+    return versions[0].get("name", "v1") if versions else "v1"
+
+
+class CRDWatcher:
+    """Watches CRDs themselves; per established CRD, watches its CRs.
+
+    Maintains a lock-guarded CR cache keyed ``group/kind/namespace``
+    (ref crd_watcher.go:353-383) with accessors ``get_crds`` /
+    ``get_custom_resources``.
+    """
+
+    def __init__(
+        self,
+        client: Client,
+        handler: EventHandler,
+        reconnect_delay: float = 5.0,
+    ) -> None:
+        self.client = client
+        self.handler = handler
+        self.reconnect_delay = reconnect_delay
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._threads: list[threading.Thread] = []
+        self._streams: list[WatchStream] = []
+        self._cr_watched: set[str] = set()  # crd metadata.name
+        self._crds: dict[str, CRDInfo] = {}
+        # group/kind/namespace -> {name: CustomResourceInfo}
+        self._cr_cache: dict[str, dict[str, CustomResourceInfo]] = {}
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        self._discover_and_watch()
+        t = threading.Thread(target=self._crd_watch_loop, name="watch-crds", daemon=True)
+        self._threads.append(t)
+        t.start()
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        with self._lock:
+            streams = list(self._streams)
+        for s in streams:
+            s.close()
+        for t in self._threads:
+            t.join(timeout=timeout)
+        self._threads.clear()
+
+    def _register(self, stream: WatchStream) -> None:
+        with self._lock:
+            self._streams.append(stream)
+        if self._stop.is_set():
+            stream.close()
+
+    # -- discovery (ref crd_watcher.go:178-201) -------------------------------
+
+    def _discover_and_watch(self) -> None:
+        try:
+            crds = self.client.backend.list_crds()
+        except ClusterError as exc:
+            logger.warning("CRD discovery failed: %s", exc)
+            return
+        for raw in crds:
+            info = convert_crd(raw)
+            with self._lock:
+                self._crds[info.name] = info
+            if info.established:
+                self._ensure_cr_watch(raw)
+
+    def _ensure_cr_watch(self, raw_crd: dict[str, Any]) -> None:
+        name = raw_crd.get("metadata", {}).get("name", "")
+        with self._lock:
+            if name in self._cr_watched:
+                return
+            self._cr_watched.add(name)
+        t = threading.Thread(
+            target=self._cr_watch_loop,
+            args=(raw_crd,),
+            name=f"watch-cr-{name}",
+            daemon=True,
+        )
+        self._threads.append(t)
+        t.start()
+
+    # -- watch loops ----------------------------------------------------------
+
+    def _crd_watch_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                stream = self.client.backend.watch_crds()
+            except ClusterError as exc:
+                logger.warning("CRD watch failed: %s; retrying", exc)
+                self._stop.wait(self.reconnect_delay)
+                continue
+            self._register(stream)
+            try:
+                for event_type, raw in stream:
+                    if self._stop.is_set():
+                        return
+                    info = convert_crd(raw)
+                    with self._lock:
+                        if event_type == "DELETED":
+                            self._crds.pop(info.name, None)
+                        else:
+                            self._crds[info.name] = info
+                    # Established may arrive on the later MODIFIED event, not
+                    # the initial ADDED (real API servers set the condition
+                    # asynchronously); _ensure_cr_watch dedups, so check both.
+                    if event_type in ("ADDED", "MODIFIED") and info.established:
+                        self._ensure_cr_watch(raw)
+            finally:
+                with self._lock:
+                    if stream in self._streams:
+                        self._streams.remove(stream)
+            self._stop.wait(self.reconnect_delay)
+
+    def _cr_watch_loop(self, raw_crd: dict[str, Any]) -> None:
+        spec = raw_crd.get("spec", {})
+        group = spec.get("group", "")
+        names = spec.get("names", {})
+        kind = names.get("kind", "")
+        plural = names.get("plural", "")
+        version = storage_version(raw_crd)
+        namespaced = spec.get("scope", "Namespaced") == "Namespaced"
+        while not self._stop.is_set():
+            try:
+                stream = self.client.backend.watch_custom_resources(
+                    group, version, plural, None if not namespaced else ""
+                )
+            except ClusterError as exc:
+                logger.warning("CR watch %s.%s failed: %s", plural, group, exc)
+                self._stop.wait(self.reconnect_delay)
+                continue
+            self._register(stream)
+            try:
+                for event_type, obj in stream:
+                    if self._stop.is_set():
+                        return
+                    self._handle_cr_event(event_type, obj, group, kind, version)
+            finally:
+                with self._lock:
+                    if stream in self._streams:
+                        self._streams.remove(stream)
+            self._stop.wait(self.reconnect_delay)
+
+    def _handle_cr_event(
+        self, event_type: str, obj: dict[str, Any], group: str, kind: str, version: str
+    ) -> None:
+        from k8s_llm_monitor_tpu.monitor.client import convert_custom_resource
+
+        info = convert_custom_resource(obj, group, kind)
+        cache_key = f"{group}/{kind}/{info.namespace}"
+        with self._lock:
+            bucket = self._cr_cache.setdefault(cache_key, {})
+            if event_type == "DELETED":
+                bucket.pop(info.name, None)
+            else:
+                bucket[info.name] = info
+        self.handler.on_crd_event(
+            CRDEvent(
+                type={"ADDED": "Added", "MODIFIED": "Modified", "DELETED": "Deleted"}.get(
+                    event_type, event_type
+                ),
+                kind=kind,
+                group=group,
+                version=version,
+                name=info.name,
+                namespace=info.namespace,
+                object=dict(obj),
+                timestamp=utcnow(),
+            )
+        )
+
+    # -- accessors (ref crd_watcher.go:386-407) --------------------------------
+
+    def get_crds(self) -> list[CRDInfo]:
+        with self._lock:
+            return list(self._crds.values())
+
+    def get_custom_resources(self) -> dict[str, list[CustomResourceInfo]]:
+        with self._lock:
+            return {k: list(v.values()) for k, v in self._cr_cache.items()}

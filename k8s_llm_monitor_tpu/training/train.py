@@ -82,17 +82,23 @@ def shard_train_state(state: TrainState, mesh: Mesh) -> TrainState:
 
     params = jax.tree.map(put, state.params, pspecs)
 
-    def put_opt(leaf):
-        # Adam moments have param shapes -> same spec as the matching param;
-        # anything else (counts, scales) replicates.  We match by shape
-        # against a flattened param list, which is unambiguous here because
-        # moments are exact shape copies.
-        for p, s in zip(jax.tree.leaves(params), jax.tree.leaves(pspecs)):
-            if hasattr(leaf, "shape") and leaf.shape == p.shape and leaf.ndim > 0:
-                return jax.device_put(leaf, NamedSharding(mesh, s))
-        return jax.device_put(leaf, NamedSharding(mesh, P()))
+    def put_opt(node):
+        # Adam moments mirror the param pytree structurally, so shard them
+        # with the param specs (shape matching is ambiguous: q and o
+        # projections are both [H, H]); counts/scales replicate.
+        if isinstance(node, optax.ScaleByAdamState):
+            return optax.ScaleByAdamState(
+                count=put(node.count, P()),
+                mu=jax.tree.map(put, node.mu, pspecs),
+                nu=jax.tree.map(put, node.nu, pspecs),
+            )
+        return put(node, P()) if hasattr(node, "shape") else node
 
-    opt_state = jax.tree.map(put_opt, state.opt_state)
+    opt_state = jax.tree.map(
+        put_opt,
+        state.opt_state,
+        is_leaf=lambda x: isinstance(x, optax.ScaleByAdamState),
+    )
     return TrainState(params=params, opt_state=opt_state, step=state.step)
 
 

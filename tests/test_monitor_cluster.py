@@ -1,0 +1,325 @@
+"""Control-plane foundation + cluster access tests.
+
+Covers config loading (defaults / YAML / env precedence like ref
+internal/config/config.go:105-182), model JSON serialization, the fake
+cluster backend, client conversions (ref internal/k8s/converter.go), the
+UAVMetric CRD upsert contract (ref client.go:316-450), and the
+reconnecting watchers (ref watcher.go, crd_watcher.go).
+"""
+
+import threading
+import time
+
+import pytest
+
+from k8s_llm_monitor_tpu.monitor.client import Client, sanitize_resource_name
+from k8s_llm_monitor_tpu.monitor.cluster import (
+    FakeCluster,
+    NotFound,
+    parse_cpu_millis,
+    parse_mem_bytes,
+    seed_demo_cluster,
+)
+from k8s_llm_monitor_tpu.monitor.config import load_config
+from k8s_llm_monitor_tpu.monitor.models import (
+    NetworkPolicyRule,
+    PeerRule,
+    UAVReport,
+    rfc3339,
+    to_jsonable,
+    utcnow,
+)
+from k8s_llm_monitor_tpu.monitor.watcher import CRDWatcher, EventHandler, Watcher
+
+
+# ---------------------------------------------------------------------------
+# config
+# ---------------------------------------------------------------------------
+
+
+def test_config_defaults():
+    cfg = load_config(None)
+    assert cfg.server.port == 8080
+    assert cfg.server.host == "0.0.0.0"
+    assert cfg.metrics.collect_interval == 30
+    assert cfg.analysis.max_context_events == 100
+    assert cfg.llm.max_tokens == 2000
+    assert cfg.storage.type == "memory"
+
+
+def test_config_yaml_and_env(tmp_path, monkeypatch):
+    p = tmp_path / "config.yaml"
+    p.write_text(
+        """
+server:
+  port: 9999
+  debug: true
+k8s:
+  watch_namespaces: [default, kube-system]
+llm:
+  provider: tpu
+  tpu:
+    model: llama-8b
+metrics:
+  enable_network: true
+"""
+    )
+    monkeypatch.setenv("SERVER_PORT", "7777")  # env beats file
+    monkeypatch.setenv("OPENAI_API_KEY", "sk-test")
+    cfg = load_config(str(p))
+    assert cfg.server.port == 7777
+    assert cfg.server.debug is True
+    assert cfg.k8s.watch_namespaces == ["default", "kube-system"]
+    assert cfg.metrics.namespaces == ["default", "kube-system"]
+    assert cfg.llm.provider == "tpu"
+    assert cfg.llm.tpu.model == "llama-8b"
+    assert cfg.llm.api_key == "sk-test"  # OPENAI_API_KEY alias
+    assert cfg.metrics.enable_network is True
+
+
+def test_config_missing_explicit_file_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_config(str(tmp_path / "nope.yaml"))
+
+
+# ---------------------------------------------------------------------------
+# models / serialization
+# ---------------------------------------------------------------------------
+
+
+def test_to_jsonable_omitempty_and_from_key():
+    rule = NetworkPolicyRule(from_=[PeerRule(pod_selector={"app": "a"})])
+    d = to_jsonable(rule)
+    assert "from" in d and "from_" not in d
+    assert d["from"][0]["pod_selector"] == {"app": "a"}
+
+    report = UAVReport(node_name="n1", uav_id="uav-n1")
+    d = to_jsonable(report)
+    assert "node_ip" not in d  # omitempty drops zero values
+    assert "state" not in d
+    assert d["node_name"] == "n1"
+    assert d["timestamp"].endswith("Z")
+
+
+def test_rfc3339_format():
+    from datetime import datetime, timezone
+
+    ts = datetime(2026, 7, 29, 12, 0, 5, tzinfo=timezone.utc)
+    assert rfc3339(ts) == "2026-07-29T12:00:05Z"
+
+
+def test_quantity_parsing():
+    assert parse_cpu_millis("250m") == 250
+    assert parse_cpu_millis("2") == 2000
+    assert parse_cpu_millis("1.5") == 1500
+    assert parse_cpu_millis("1500000n") == 1
+    assert parse_mem_bytes("128Mi") == 128 * 1024**2
+    assert parse_mem_bytes("1Gi") == 1024**3
+    assert parse_mem_bytes("1000") == 1000
+
+
+# ---------------------------------------------------------------------------
+# fake cluster + client
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def demo():
+    fake = seed_demo_cluster(FakeCluster())
+    client = Client(fake, namespaces=["default", "kube-system"])
+    return fake, client
+
+
+def test_cluster_info(demo):
+    fake, client = demo
+    info = client.get_cluster_info()
+    assert info["nodes"] == 3
+    assert info["pods"] == 3
+    assert info["namespaces"] == ["default", "kube-system"]
+    assert client.test_connection() == "v1.29.0-fake"
+
+
+def test_pod_conversion(demo):
+    fake, client = demo
+    pods = client.get_pods("default")
+    assert len(pods) == 2
+    web = next(p for p in pods if p.name.startswith("web-frontend"))
+    assert web.status == "Running"
+    assert web.node_name == "k3d-demo-agent-0"
+    assert web.ip.startswith("10.244.")
+    assert web.containers[0].state == "running"
+    assert web.containers[0].ready is True
+
+
+def test_env_secret_filtering():
+    fake = FakeCluster()
+    fake.add_pod(
+        "p1",
+        env={"APP_MODE": "prod", "DB_PASSWORD": "hunter2", "API_TOKEN": "t"},
+    )
+    client = Client(fake)
+    pod = client.get_pod("default", "p1")
+    env = pod.containers[0].env
+    assert env == {"APP_MODE": "prod"}  # secret-looking names dropped
+
+
+def test_services_events_logs(demo):
+    fake, client = demo
+    svcs = client.get_services("default")
+    assert svcs[0].name == "api-backend"
+    assert svcs[0].ports[0].port == 8080
+    evs = client.get_events("default", limit=10)
+    assert evs and evs[0].reason == "Scheduled"
+    logs = client.get_pod_logs("default", "api-backend-6f5d8b7c9-k3k2m")
+    assert "listening on :8080" in logs
+    with pytest.raises(NotFound):
+        client.get_pod_logs("default", "ghost")
+
+
+def test_event_limit():
+    fake = FakeCluster()
+    for i in range(20):
+        fake.add_event(reason=f"r{i}", message="m")
+    client = Client(fake)
+    evs = client.get_events("default", limit=5)
+    assert len(evs) == 5
+    assert evs[-1].reason == "r19"  # most recent kept
+
+
+def test_sanitize_resource_name():
+    assert sanitize_resource_name("Node_A.local") == "node-a-local"
+    assert sanitize_resource_name("") == "unknown"
+
+
+def test_uav_metric_upsert_create_then_update(demo):
+    fake, client = demo
+    report = UAVReport(
+        node_name="k3d-demo-agent-0",
+        node_ip="172.18.0.3",
+        uav_id="uav-agent-0",
+        status="active",
+        state={
+            "gps": {"latitude": 39.9, "longitude": 116.4, "altitude": 50.0},
+            "battery": {"voltage": 22.2, "remaining_percent": 87.5},
+            "flight": {"mode": "AUTO", "armed": True},
+            "health": {"system_status": "OK"},
+        },
+    )
+    client.upsert_uav_metric("", report)
+    crs = client.list_uav_metrics_crd()
+    assert len(crs) == 1
+    cr = crs[0]
+    assert cr.name == "uavmetric-k3d-demo-agent-0"
+    assert cr.spec["battery"]["remaining_percent"] == 87.5
+    assert cr.status["collection_status"] == "active"
+    assert cr.generation == 1
+
+    # update path bumps generation, merges labels, swaps spec
+    report.state["battery"]["remaining_percent"] = 42.0
+    client.upsert_uav_metric("", report)
+    cr = client.list_uav_metrics_crd()[0]
+    assert cr.spec["battery"]["remaining_percent"] == 42.0
+    assert cr.generation == 2
+
+
+def test_failure_injection(demo):
+    fake, client = demo
+    fake.fail_next("list_pods", times=1)
+    info = client.get_cluster_info()  # pod listing degrades, nodes still there
+    assert info["nodes"] == 3
+    assert info["pods"] == 1  # only kube-system listed successfully
+
+
+# ---------------------------------------------------------------------------
+# watchers
+# ---------------------------------------------------------------------------
+
+
+class RecordingHandler(EventHandler):
+    def __init__(self):
+        self.pods = []
+        self.services = []
+        self.events = []
+        self.crd_events = []
+        self.got = threading.Event()
+
+    def on_pod_update(self, event_type, pod):
+        self.pods.append((event_type, pod.name))
+        self.got.set()
+
+    def on_service_update(self, event_type, service):
+        self.services.append((event_type, service.name))
+
+    def on_event(self, event):
+        self.events.append(event.reason)
+
+    def on_crd_event(self, event):
+        self.crd_events.append((event.type, event.name))
+        self.got.set()
+
+
+def _wait(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def test_watcher_delivers_and_reconnects():
+    fake = FakeCluster()
+    client = Client(fake)
+    handler = RecordingHandler()
+    w = Watcher(client, handler, reconnect_delay=0.05)
+    w.start()
+    try:
+        assert _wait(lambda: fake._watchers)  # streams registered
+        fake.add_pod("p1")
+        assert _wait(lambda: ("ADDED", "p1") in handler.pods)
+
+        # sever every stream; the watcher must reconnect and keep delivering
+        fake.close_watches()
+        assert _wait(lambda: fake._watchers)
+        fake.add_pod("p2")
+        assert _wait(lambda: ("ADDED", "p2") in handler.pods)
+
+        fake.update_pod("default", "p2", phase="Failed")
+        assert _wait(lambda: ("MODIFIED", "p2") in handler.pods)
+
+        fake.add_event(reason="BackOff", message="restarting")
+        assert _wait(lambda: "BackOff" in handler.events)
+    finally:
+        w.stop()
+    assert not any(t.is_alive() for t in w._threads)
+
+
+def test_crd_watcher_cache_and_events():
+    fake = FakeCluster()
+    fake.define_crd("monitoring.io", "UAVMetric", "uavmetrics")
+    client = Client(fake)
+    handler = RecordingHandler()
+    cw = CRDWatcher(client, handler, reconnect_delay=0.05)
+    cw.start()
+    try:
+        assert _wait(lambda: len(cw.get_crds()) == 1)
+        assert _wait(lambda: ("cr", "monitoring.io", "uavmetrics", "") in fake._watchers)
+        fake.create_custom_resource(
+            "monitoring.io",
+            "v1",
+            "uavmetrics",
+            "default",
+            {"metadata": {"name": "uavmetric-n1"}, "spec": {"uav_id": "u1"}},
+        )
+        assert _wait(lambda: ("Added", "uavmetric-n1") in handler.crd_events)
+        cache = cw.get_custom_resources()
+        assert "monitoring.io/UAVMetric/default" in cache
+        assert cache["monitoring.io/UAVMetric/default"][0].spec["uav_id"] == "u1"
+
+        # a CRD defined later gets its CR watch spawned from the CRD stream
+        fake.define_crd("scheduler.io", "SchedulingRequest", "schedulingrequests")
+        assert _wait(
+            lambda: ("cr", "scheduler.io", "schedulingrequests", "") in fake._watchers
+        )
+    finally:
+        cw.stop()
