@@ -1,0 +1,213 @@
+"""Metrics sources + manager tests (ref internal/metrics/)."""
+
+import pytest
+
+from k8s_llm_monitor_tpu.monitor.client import Client
+from k8s_llm_monitor_tpu.monitor.cluster import FakeCluster
+from k8s_llm_monitor_tpu.monitor.config import MetricsConfig
+from k8s_llm_monitor_tpu.monitor.manager import CollectError, Manager
+from k8s_llm_monitor_tpu.monitor.models import UAVReport
+from k8s_llm_monitor_tpu.monitor.sources import (
+    NetworkMetricsSource,
+    NodeMetricsSource,
+    PodMetricsSource,
+    UAVMetricsSource,
+)
+
+
+@pytest.fixture
+def cluster():
+    fake = FakeCluster()
+    fake.add_node("n1", cpu="4", memory="8Gi")
+    fake.add_node("n2", cpu="8", memory="16Gi", tpu_chips=4)
+    fake.set_node_usage("n1", cpu="1000m", memory="4Gi")
+    fake.set_node_usage("n2", cpu="2000m", memory="4Gi")
+    fake.add_pod(
+        "app-1",
+        node="n1",
+        labels={"app": "app"},
+        requests={"cpu": "100m", "memory": "128Mi"},
+        limits={"cpu": "200m", "memory": "256Mi"},
+        image="busybox:1.36",
+    )
+    fake.add_pod("web-1", node="n2", labels={"app": "web"}, image="nginx:1.25")
+    fake.set_pod_usage("default", "app-1", cpu="150m", memory="128Mi")
+    client = Client(fake, namespaces=["default"])
+    return fake, client
+
+
+def test_node_source(cluster):
+    fake, client = cluster
+    nodes = NodeMetricsSource(client).collect()
+    assert set(nodes) == {"n1", "n2"}
+    n1 = nodes["n1"]
+    assert n1.cpu_capacity == 4000
+    assert n1.cpu_usage == 1000
+    assert n1.cpu_usage_rate == 25.0
+    assert n1.memory_usage_rate == 50.0
+    assert n1.healthy
+    # disk estimated as capacity - allocatable (5% in the fake)
+    assert 0 < n1.disk_usage_rate < 10
+    # TPU chips surface through accelerator fields
+    n2 = nodes["n2"]
+    assert n2.gpu_count == 4
+    assert n2.custom_metrics["accelerator_type"] == "tpu"
+
+
+def test_node_source_degrades_without_metrics_server(cluster):
+    fake, client = cluster
+    fake.metrics_server_available = False
+    nodes = NodeMetricsSource(client).collect()
+    assert nodes["n1"].cpu_capacity == 4000  # capacity-only
+    assert nodes["n1"].cpu_usage == 0
+
+
+def test_node_unhealthy_conditions(cluster):
+    fake, client = cluster
+    fake.add_node("bad", ready=False, pressure=["MemoryPressure"])
+    nodes = NodeMetricsSource(client).collect()
+    bad = nodes["bad"]
+    assert not bad.healthy
+    assert "MemoryPressure" in bad.conditions
+    assert "NotReady" in bad.conditions
+
+
+def test_pod_source(cluster):
+    fake, client = cluster
+    pods = PodMetricsSource(client, ["default"]).collect()
+    pm = pods["default/app-1"]
+    assert pm.cpu_request == 100
+    assert pm.cpu_limit == 200
+    assert pm.cpu_usage == 150
+    assert pm.cpu_usage_rate == 75.0  # vs limit
+    assert pm.memory_usage_rate == 50.0
+    assert pm.ready
+    assert pm.phase == "Running"
+    assert len(pm.containers) == 1
+
+
+def test_network_source_pairs_prefer_cross_node(cluster):
+    fake, client = cluster
+    fake.add_pod("app-2", node="n1", labels={"app": "app2"}, image="busybox:1.36")
+    src = NetworkMetricsSource(client, ["default"], max_pairs=2)
+    pairs = src.select_pod_pairs()
+    assert len(pairs) == 2
+    # both selected pairs should be cross-node (app-1/n1 x web-1/n2 etc.)
+    assert ("default/app-1", "default/web-1") in pairs
+
+
+def test_network_source_collect(cluster):
+    fake, client = cluster
+    metrics = NetworkMetricsSource(client, ["default"], max_pairs=3).collect()
+    assert metrics
+    m = metrics[0]
+    assert m.connected
+    assert m.rtt_ms > 0
+    # web-1 is nginx → http preferred for pairs targeting it
+    methods = {x.test_method for x in metrics}
+    assert "http" in methods or "ping" in methods
+
+
+def test_uav_source_pull(cluster):
+    fake, client = cluster
+    fake.add_pod(
+        "uav-agent-abc",
+        node="n1",
+        labels={"app": "uav-agent"},
+        image="uav-agent:dev",
+    )
+    calls = []
+
+    def fetcher(url):
+        calls.append(url)
+        return {"uav_id": "uav-n1", "battery": {"remaining_percent": 80.0}}
+
+    src = UAVMetricsSource(client, "default", fetcher=fetcher)
+    out = src.collect()
+    assert list(out) == ["n1"]
+    assert out["n1"]["uav_id"] == "uav-n1"
+    assert calls and ":9090/api/v1/state" in calls[0]
+
+
+def test_manager_collect_and_rollup(cluster):
+    fake, client = cluster
+    mgr = Manager(client, MetricsConfig(namespaces=["default"], enable_network=True))
+    snap = mgr.collect()
+    assert snap.cluster_metrics.total_nodes == 2
+    assert snap.cluster_metrics.healthy_nodes == 2
+    assert snap.cluster_metrics.total_pods == 2
+    assert snap.cluster_metrics.running_pods == 2
+    assert snap.cluster_metrics.total_cpu == 12000
+    assert snap.cluster_metrics.used_cpu == 3000
+    assert snap.cluster_metrics.total_gpus == 4
+    assert snap.cluster_metrics.health_status == "healthy"
+    assert snap.network_metrics  # network probes ran
+    assert mgr.get_node_metrics("n1").cpu_capacity == 4000
+    with pytest.raises(KeyError):
+        mgr.get_node_metrics("ghost")
+
+
+def test_manager_health_warning_and_critical(cluster):
+    fake, client = cluster
+    fake.set_node_usage("n1", cpu="3500m", memory="7Gi")
+    fake.set_node_usage("n2", cpu="7000m", memory="14Gi")
+    mgr = Manager(client, MetricsConfig(namespaces=["default"]))
+    snap = mgr.collect()
+    assert snap.cluster_metrics.cpu_usage_rate > 80
+    assert snap.cluster_metrics.health_status in ("warning", "critical")
+
+    fake.set_node_usage("n1", cpu="3900m", memory="7.9Gi")
+    fake.set_node_usage("n2", cpu="7900m", memory="15.8Gi")
+    snap = mgr.collect()
+    assert snap.cluster_metrics.health_status == "critical"
+
+
+def test_manager_node_error_propagates(cluster):
+    fake, client = cluster
+    fake.fail_next("list_nodes", times=1)
+    mgr = Manager(client, MetricsConfig(namespaces=["default"]))
+    with pytest.raises(CollectError):
+        mgr.collect()
+    # network errors must NOT propagate (log-only policy)
+    fake.fail_next("exec_in_pod", times=100)
+    mgr2 = Manager(client, MetricsConfig(namespaces=["default"], enable_network=True))
+    mgr2.collect()  # no raise
+
+
+def test_manager_uav_push_beats_pull(cluster):
+    fake, client = cluster
+    mgr = Manager(client, MetricsConfig(namespaces=["default"]))
+    mgr.update_uav_report(
+        UAVReport(
+            node_name="n1",
+            uav_id="uav-n1",
+            source="agent",
+            heartbeat_interval_seconds=10,
+            state={"battery": {"remaining_percent": 55.0}},
+        )
+    )
+    uavs = mgr.get_uav_metrics()
+    assert uavs["n1"]["source"] == "agent"
+    assert uavs["n1"]["heartbeat_interval_seconds"] == 10
+    single = mgr.get_single_uav_metrics("n1")
+    assert single["uav_id"] == "uav-n1"
+    assert mgr.get_single_uav_metrics("ghost") is None
+
+    # a collect cycle (no agent pods → empty pull) must not clobber a fresh
+    # agent-push entry
+    mgr.collect()
+    assert mgr.get_uav_metrics()["n1"]["source"] == "agent"
+
+
+def test_manager_start_stop_loop(cluster):
+    fake, client = cluster
+    mgr = Manager(client, MetricsConfig(namespaces=["default"], collect_interval=3600))
+    mgr.start()
+    import time
+
+    deadline = time.monotonic() + 5
+    while mgr.collect_count == 0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    mgr.stop()
+    assert mgr.collect_count >= 1
+    assert mgr.get_latest_snapshot().cluster_metrics.total_nodes == 2

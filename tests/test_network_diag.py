@@ -1,0 +1,141 @@
+"""Network analyzer + RTT tester tests (ref internal/k8s/network.go,
+rtt_tester.go) against the fake cluster's exec simulator."""
+
+import pytest
+
+from k8s_llm_monitor_tpu.monitor.client import Client
+from k8s_llm_monitor_tpu.monitor.cluster import FakeCluster
+from k8s_llm_monitor_tpu.monitor.network import NetworkAnalyzer
+from k8s_llm_monitor_tpu.monitor.rtt import (
+    RTTTester,
+    assess_latency,
+    is_http_service,
+    parse_ping_output,
+    parse_pod_ref,
+)
+
+
+@pytest.fixture
+def cluster():
+    fake = FakeCluster()
+    fake.add_node("node-a")
+    fake.add_node("node-b")
+    fake.add_pod(
+        "app-a", node="node-a", labels={"app": "app-a"}, image="busybox:1.36"
+    )
+    fake.add_pod("web-b", node="node-b", labels={"app": "web-b"}, image="nginx:1.25")
+    fake.add_pod(
+        "coredns-abc",
+        namespace="kube-system",
+        node="node-a",
+        labels={"k8s-app": "kube-dns"},
+    )
+    fake.add_service("web-b-svc", selector={"app": "web-b"})
+    client = Client(fake, namespaces=["default", "kube-system"])
+    return fake, client
+
+
+def test_parse_pod_ref():
+    assert parse_pod_ref("ns1/p1") == ("ns1", "p1")
+    assert parse_pod_ref("p1") == ("default", "p1")
+
+
+def test_parse_ping_output():
+    out = (
+        "PING 10.0.0.1 (10.0.0.1): 56 data bytes\n"
+        "64 bytes from 10.0.0.1: icmp_seq=0 ttl=64 time=0.5 ms\n"
+        "64 bytes from 10.0.0.1: icmp_seq=1 ttl=64 time=1.5 ms\n"
+        "--- 10.0.0.1 ping statistics ---\n"
+        "3 packets transmitted, 2 packets received, 33% packet loss\n"
+    )
+    avg, count, loss = parse_ping_output(out)
+    assert avg == 1.0
+    assert count == 2
+    assert loss == 33.0
+
+
+def test_assess_latency_bands():
+    assert assess_latency(0) == "unknown"
+    assert assess_latency(0.5) == "excellent"
+    assert assess_latency(3) == "good"
+    assert assess_latency(30) == "fair"
+    assert assess_latency(70) == "poor"
+    assert assess_latency(200) == "very_poor"
+
+
+def test_rtt_cross_node_probe(cluster):
+    fake, client = cluster
+    tester = RTTTester(client)
+    result = tester.test_pod_connectivity("app-a", "web-b")
+    # ping + ping_reverse + http (web-b is nginx)
+    assert result.test_count == 3
+    methods = [r.method for r in result.rtt_results]
+    assert methods == ["ping", "ping_reverse", "http"]
+    assert all(r.success for r in result.rtt_results)
+    assert result.success_rate == 100.0
+    # cross-node synthetic RTT is 2.5ms → "good"
+    assert result.latency_assessment == "good"
+
+
+def test_rtt_same_node_faster(cluster):
+    fake, client = cluster
+    fake.add_pod("app-c", node="node-a", labels={"app": "app-c"})
+    tester = RTTTester(client)
+    result = tester.test_pod_connectivity("app-a", "app-c")
+    assert result.average_rtt_ms < 1.0  # same-node → excellent band
+    assert result.latency_assessment == "excellent"
+
+
+def test_is_http_service(cluster):
+    fake, client = cluster
+    assert is_http_service(client.get_pod("default", "web-b"))
+    assert not is_http_service(client.get_pod("default", "app-a"))
+
+
+def test_analyze_healthy_pair_connected(cluster):
+    fake, client = cluster
+    analyzer = NetworkAnalyzer(client)
+    a = analyzer.analyze_pod_communication("app-a", "web-b")
+    assert a.status == "connected"
+    assert a.confidence == 0.9
+    assert a.issues == []
+    assert "No obvious issues detected" in a.solutions
+
+
+def test_analyze_not_running_pod(cluster):
+    fake, client = cluster
+    fake.update_pod("default", "web-b", phase="CrashLoopBackOff")
+    analyzer = NetworkAnalyzer(client)
+    a = analyzer.analyze_pod_communication("app-a", "web-b")
+    assert a.status == "disconnected"
+    assert a.confidence == 0.7
+    assert any("is not running" in i for i in a.issues)
+
+
+def test_analyze_netpol_flagged(cluster):
+    fake, client = cluster
+    fake.add_network_policy("deny-web", pod_selector={"app": "web-b"})
+    analyzer = NetworkAnalyzer(client)
+    a = analyzer.analyze_pod_communication("app-a", "web-b")
+    assert any("deny-web" in i for i in a.issues)
+    assert any("Review network policy" in s for s in a.solutions)
+
+
+def test_analyze_no_service_and_no_dns(cluster):
+    fake, client = cluster
+    fake.add_pod("lonely", node="node-b", labels={"app": "lonely"})
+    fake.update_pod("kube-system", "coredns-abc", phase="Pending")
+    analyzer = NetworkAnalyzer(client)
+    a = analyzer.analyze_pod_communication("app-a", "lonely")
+    assert any("No service found targeting" in i for i in a.issues)
+    assert any("CoreDNS is not running" in i for i in a.issues)
+
+
+def test_analyze_rtt_exec_failure_degrades(cluster):
+    fake, client = cluster
+    fake.fail_next("exec_in_pod", times=10)
+    analyzer = NetworkAnalyzer(client)
+    a = analyzer.analyze_pod_communication("app-a", "web-b")
+    # probes failed → success rate 0 → connectivity issue reported
+    assert any("success rate" in i.lower() for i in a.issues)
+    assert a.status == "disconnected"
