@@ -99,6 +99,7 @@ class MetricsConfig:
     enable_node: bool = True
     enable_pod: bool = True
     enable_network: bool = False
+    enable_uav: bool = True
     enable_custom: bool = False
     cache_retention: int = 300
     max_pod_pairs: int = 5

@@ -79,15 +79,19 @@ class Manager:
         )
         # UAV collector targets the first configured namespace with the
         # hardcoded agent label, like ref manager.go:121-129
-        self.uav_source = UAVMetricsSource(
-            client, namespace=namespaces[0] if namespaces else "default",
-            fetcher=uav_fetcher,
+        self.uav_source = (
+            UAVMetricsSource(
+                client,
+                namespace=namespaces[0] if namespaces else "default",
+                fetcher=uav_fetcher,
+            )
+            if cfg.enable_uav
+            else None
         )
 
         self._lock = threading.RLock()
         self._snapshot = MetricsSnapshot(cluster_metrics=ClusterMetrics())
         self._uav_snapshot: dict[str, dict[str, Any]] = {}
-        self._uav_heartbeat: dict[str, datetime] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.collect_count = 0
@@ -108,6 +112,11 @@ class Manager:
         self._stop.set()
         if self._thread is not None:
             self._thread.join(timeout=timeout)
+            if self._thread.is_alive():
+                # a probe is blocking collect(); keep the handle so a later
+                # start() can't spawn a second concurrent loop
+                logger.warning("metrics loop still busy after %.1fs stop", timeout)
+                return
             self._thread = None
 
     def _loop(self) -> None:
@@ -195,11 +204,16 @@ class Manager:
                 # Rebuild from this cycle's pull results (the reference
                 # replaces the snapshot wholesale, which self-prunes removed
                 # nodes), then retain push-side ("agent") entries whose
-                # heartbeat is still fresh — pushes carry richer state.
-                fresh_window = max(self.cfg.collect_interval * 2, 30)
+                # heartbeat is still fresh — pushes carry richer state. An
+                # entry advertising its own heartbeat interval widens the
+                # window so slow pushers don't flap between shapes.
                 merged = dict(uav_entries)
                 for node, existing in self._uav_snapshot.items():
                     hb = existing.get("last_heartbeat")
+                    interval = existing.get("heartbeat_interval_seconds", 0) or 0
+                    fresh_window = max(
+                        interval * 2, self.cfg.collect_interval * 2, 30
+                    )
                     if (
                         existing.get("source") == "agent"
                         and isinstance(hb, datetime)
@@ -207,12 +221,6 @@ class Manager:
                     ):
                         merged[node] = existing
                 self._uav_snapshot = merged
-                self._uav_heartbeat = {
-                    node: _aware(e["last_heartbeat"])
-                    if isinstance(e.get("last_heartbeat"), datetime)
-                    else now
-                    for node, e in merged.items()
-                }
 
         self.last_collect_duration = time.monotonic() - start
         self.collect_count += 1
@@ -260,7 +268,6 @@ class Manager:
             entry["state"] = report.state
         with self._lock:
             self._uav_snapshot[report.node_name] = entry
-            self._uav_heartbeat[report.node_name] = ts
         logger.debug(
             "UAV report ingested: node=%s uav=%s status=%s",
             report.node_name,
@@ -306,8 +313,13 @@ class Manager:
             return dict(entry) if entry is not None else None
 
     def uav_heartbeats(self) -> dict[str, datetime]:
+        """Derived from the snapshot entries — single source of truth."""
         with self._lock:
-            return dict(self._uav_heartbeat)
+            return {
+                node: _aware(e["last_heartbeat"])
+                for node, e in self._uav_snapshot.items()
+                if isinstance(e.get("last_heartbeat"), datetime)
+            }
 
     def test_pod_communication(self, pod_a: str, pod_b: str) -> NetworkMetrics:
         """On-demand single-pair probe (ref network_metrics.go:292-325)."""

@@ -173,6 +173,11 @@ class RTTTester:
             result.error_message = f"http exec failed: {exc}"
             logger.error("curl from %s to %s failed: %s", pod.name, target_ip, exc)
             return result
+        if rc != 0:
+            # curl prints %{time_total} even on failure (e.g. connection
+            # refused) — a nonzero exit must not count as a timed success
+            result.error_message = stderr.strip() or f"curl exited {rc}"
+            return result
         try:
             # curl -w time_total prints seconds (ref rtt_tester.go:253-264)
             result.rtt_ms = float(stdout.strip()) * 1000.0

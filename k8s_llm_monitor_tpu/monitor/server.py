@@ -1,0 +1,590 @@
+"""The HTTP JSON API server.
+
+Parity target: ``/root/reference/cmd/server/main.go`` — the 14 registered
+routes (:97-141) with the exact response envelopes of the handlers
+(:175-695), including the nil-tolerant "development mode" degradation
+(:196-204, :330-333), per-handler method checks, and the CORS header on
+metrics routes (:328). Plus the endpoint the reference documents but never
+registered: ``POST /api/v1/query`` (README.md:89-95), backed by the
+Analysis Engine, and its typed sibling ``POST /api/v1/analyze``.
+
+Stdlib ``ThreadingHTTPServer`` — no web framework needed; request
+concurrency is thread-per-connection, with the inference engine doing its
+own continuous batching underneath.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import mimetypes
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from pathlib import Path
+from typing import Any, Callable
+from urllib.parse import parse_qs, urlparse
+
+from k8s_llm_monitor_tpu.monitor.analysis import AnalysisEngine
+from k8s_llm_monitor_tpu.monitor.client import Client
+from k8s_llm_monitor_tpu.monitor.cluster import ClusterError, NotFound
+from k8s_llm_monitor_tpu.monitor.config import Config
+from k8s_llm_monitor_tpu.monitor.manager import Manager
+from k8s_llm_monitor_tpu.monitor.models import (
+    AnalysisRequest,
+    UAVReport,
+    parse_rfc3339,
+    rfc3339,
+    to_jsonable,
+    utcnow,
+)
+from k8s_llm_monitor_tpu.monitor.network import NetworkAnalyzer
+
+logger = logging.getLogger("monitor.server")
+
+VERSION = "1.0.0"
+DEFAULT_WEB_DIR = Path(__file__).resolve().parents[2] / "web"
+
+
+def _now() -> str:
+    return rfc3339(utcnow())
+
+
+class MonitorServer:
+    """Owns the HTTP server + the wired components.
+
+    Every component is optional (dev mode): handlers degrade exactly like
+    the reference when ``client`` / ``manager`` / ``analysis`` is None.
+    """
+
+    def __init__(
+        self,
+        config: Config | None = None,
+        client: Client | None = None,
+        manager: Manager | None = None,
+        analysis: AnalysisEngine | None = None,
+        web_dir: str | Path | None = None,
+        host: str | None = None,
+        port: int | None = None,
+    ) -> None:
+        self.config = config or Config()
+        self.client = client
+        self.manager = manager
+        self.analysis = analysis
+        self.web_dir = Path(web_dir) if web_dir else DEFAULT_WEB_DIR
+        self.host = host if host is not None else self.config.server.host
+        self.port = port if port is not None else self.config.server.port
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
+        self.port = self._httpd.server_address[1]  # resolve port 0
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="monitor-http", daemon=True
+        )
+        self._thread.start()
+        logger.info("monitor server listening on %s:%d", self.host, self.port)
+
+    def stop(self) -> None:
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    def serve_forever(self) -> None:
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
+        self.port = self._httpd.server_address[1]
+        logger.info("monitor server listening on %s:%d", self.host, self.port)
+        try:
+            self._httpd.serve_forever()
+        except KeyboardInterrupt:
+            logger.info("shutting down server...")
+            self._httpd.server_close()
+
+
+def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        # quiet default logging; route through our logger at debug
+        def log_message(self, fmt: str, *args: Any) -> None:
+            logger.debug("%s %s", self.address_string(), fmt % args)
+
+        # -- plumbing ---------------------------------------------------------
+
+        def _send_json(
+            self, payload: Any, status: int = 200, cors: bool = False
+        ) -> None:
+            body = json.dumps(to_jsonable(payload)).encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "application/json")
+            if cors:
+                self.send_header("Access-Control-Allow-Origin", "*")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _send_error_text(self, msg: str, status: int) -> None:
+            # mirrors Go http.Error: plain text + newline
+            body = (msg + "\n").encode()
+            self.send_response(status)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _read_json(self) -> Any:
+            length = int(self.headers.get("Content-Length", 0) or 0)
+            raw = self.rfile.read(length) if length else b""
+            return json.loads(raw) if raw else None
+
+        # -- routing ----------------------------------------------------------
+
+        def do_GET(self) -> None:  # noqa: N802 (stdlib API)
+            self._route("GET")
+
+        def do_POST(self) -> None:  # noqa: N802
+            self._route("POST")
+
+        def _route(self, method: str) -> None:
+            parsed = urlparse(self.path)
+            path = parsed.path
+            try:
+                routes: list[tuple[str, str, Callable[..., None]]] = [
+                    ("GET", "/health", self.h_health),
+                    ("GET", "/api/v1/cluster/status", self.h_cluster_status),
+                    ("GET", "/api/v1/pods", self.h_pods),
+                    ("POST", "/api/v1/analyze/pod-communication", self.h_pod_comm),
+                    ("POST", "/api/v1/analyze", self.h_analyze),
+                    ("POST", "/api/v1/query", self.h_query),
+                    ("GET", "/api/v1/metrics/cluster", self.h_metrics_cluster),
+                    ("GET", "/api/v1/metrics/nodes", self.h_metrics_nodes),
+                    ("GET", "/api/v1/metrics/pods", self.h_metrics_pods),
+                    ("GET", "/api/v1/metrics/snapshot", self.h_metrics_snapshot),
+                    ("GET", "/api/v1/metrics/network", self.h_metrics_network),
+                    ("GET", "/api/v1/metrics/uav", self.h_metrics_uav),
+                    ("POST", "/api/v1/uav/report", self.h_uav_report),
+                    ("GET", "/api/v1/crd/uav", self.h_uav_crd),
+                ]
+                exact = {(m, p): h for m, p, h in routes}
+                paths = {p for _, p, _ in routes}
+                if (method, path) in exact:
+                    return exact[(method, path)]()
+                # prefix routes with a path parameter
+                if path.startswith("/api/v1/metrics/nodes/"):
+                    if method != "GET":
+                        return self._send_error_text("Method not allowed", 405)
+                    return self.h_metrics_node(path[len("/api/v1/metrics/nodes/") :])
+                if path.startswith("/api/v1/metrics/uav/"):
+                    if method != "GET":
+                        return self._send_error_text("Method not allowed", 405)
+                    return self.h_metrics_uav_node(path[len("/api/v1/metrics/uav/") :])
+                if path in paths:
+                    # registered path, wrong method (ref per-handler checks)
+                    return self._send_error_text("Method not allowed", 405)
+                if method == "GET":
+                    return self.h_static(path)
+                return self._send_error_text("404 page not found", 404)
+            except BrokenPipeError:
+                pass
+            except Exception as exc:  # noqa: BLE001 — server must not die
+                logger.exception("handler error for %s %s", method, path)
+                try:
+                    self._send_error_text(f"Internal server error: {exc}", 500)
+                except Exception:  # noqa: BLE001
+                    pass
+
+        # -- static web (ref cmd/server/main.go:101) ---------------------------
+
+        def h_static(self, path: str) -> None:
+            rel = path.lstrip("/") or "index.html"
+            base = srv.web_dir.resolve()
+            target = (base / rel).resolve()
+            if not str(target).startswith(str(base)) or not target.is_file():
+                return self._send_error_text("404 page not found", 404)
+            ctype = mimetypes.guess_type(str(target))[0] or "application/octet-stream"
+            data = target.read_bytes()
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        # -- handlers ----------------------------------------------------------
+
+        def h_health(self) -> None:
+            self._send_json(
+                {"status": "healthy", "timestamp": _now(), "version": VERSION}
+            )
+
+        def h_cluster_status(self) -> None:
+            if srv.client is None:
+                return self._send_json(
+                    {
+                        "status": "warning",
+                        "message": "K8s client not available - running in development mode",
+                        "timestamp": _now(),
+                    }
+                )
+            try:
+                info = srv.client.get_cluster_info()
+            except ClusterError as exc:
+                return self._send_error_text(
+                    f"Failed to get cluster info: {exc}", 500
+                )
+            self._send_json(
+                {"status": "success", "cluster_info": info, "timestamp": _now()}
+            )
+
+        def h_pods(self) -> None:
+            if srv.client is None:
+                return self._send_json(
+                    {
+                        "status": "warning",
+                        "message": "K8s client not available - running in development mode",
+                        "pods": [],
+                        "timestamp": _now(),
+                    }
+                )
+            all_pods = []
+            for ns in srv.client.namespaces():
+                try:
+                    all_pods.extend(srv.client.get_pods(ns))
+                except ClusterError as exc:
+                    logger.warning("failed to get pods from %s: %s", ns, exc)
+            self._send_json(
+                {
+                    "status": "success",
+                    "pods": all_pods,
+                    "count": len(all_pods),
+                    "timestamp": _now(),
+                }
+            )
+
+        def h_pod_comm(self) -> None:
+            if srv.client is None:
+                return self._send_error_text(
+                    "K8s client not available - running in development mode", 503
+                )
+            try:
+                body = self._read_json() or {}
+            except json.JSONDecodeError:
+                return self._send_error_text("Invalid JSON body", 400)
+            pod_a, pod_b = body.get("pod_a", ""), body.get("pod_b", "")
+            if not pod_a or not pod_b:
+                return self._send_error_text("pod_a and pod_b are required", 400)
+            try:
+                # LLM-augmented when the Analysis Engine is wired; plain
+                # rule-based pipeline otherwise (reference behavior)
+                if srv.analysis is not None:
+                    resp = srv.analysis.analyze(
+                        AnalysisRequest(
+                            type="pod_communication",
+                            parameters={"pod_a": pod_a, "pod_b": pod_b},
+                        )
+                    )
+                    if resp.status != "success":
+                        return self._send_error_text(
+                            f"Analysis failed: {resp.error}", 500
+                        )
+                    payload = {
+                        "status": "success",
+                        "analysis": resp.result.get("analysis"),
+                        "llm_diagnosis": resp.result.get("llm_diagnosis"),
+                        "model": resp.result.get("model"),
+                        "timestamp": _now(),
+                    }
+                    return self._send_json(payload)
+                analysis = NetworkAnalyzer(srv.client).analyze_pod_communication(
+                    pod_a, pod_b
+                )
+            except NotFound as exc:
+                return self._send_error_text(f"Analysis failed: {exc}", 500)
+            except ClusterError as exc:
+                return self._send_error_text(f"Analysis failed: {exc}", 500)
+            self._send_json(
+                {"status": "success", "analysis": analysis, "timestamp": _now()}
+            )
+
+        def h_query(self) -> None:
+            if srv.analysis is None:
+                return self._send_error_text(
+                    "Analysis engine not available - running in development mode",
+                    503,
+                )
+            try:
+                body = self._read_json() or {}
+            except json.JSONDecodeError:
+                return self._send_error_text("Invalid JSON body", 400)
+            question = (body.get("question") or "").strip()
+            if not question:
+                return self._send_error_text("question is required", 400)
+            resp = srv.analysis.query(question)
+            self._send_json(resp, status=200 if resp.status == "success" else 500)
+
+        def h_analyze(self) -> None:
+            if srv.analysis is None:
+                return self._send_error_text(
+                    "Analysis engine not available - running in development mode",
+                    503,
+                )
+            try:
+                body = self._read_json() or {}
+            except json.JSONDecodeError:
+                return self._send_error_text("Invalid JSON body", 400)
+            req = AnalysisRequest(
+                type=body.get("type", ""),
+                parameters=body.get("parameters") or {},
+                context=body.get("context") or {},
+            )
+            resp = srv.analysis.analyze(req)
+            self._send_json(resp, status=200 if resp.status == "success" else 400)
+
+        # -- metrics handlers (CORS like ref :328) ------------------------------
+
+        def _need_manager(self) -> bool:
+            if srv.manager is None:
+                self._send_error_text("Metrics manager not available", 503)
+                return False
+            return True
+
+        def h_metrics_cluster(self) -> None:
+            if not self._need_manager():
+                return
+            self._send_json(
+                {
+                    "status": "success",
+                    "data": srv.manager.get_cluster_metrics(),
+                    "timestamp": _now(),
+                },
+                cors=True,
+            )
+
+        def h_metrics_nodes(self) -> None:
+            if not self._need_manager():
+                return
+            snap = srv.manager.get_latest_snapshot()
+            self._send_json(
+                {
+                    "status": "success",
+                    "data": snap.node_metrics,
+                    "count": len(snap.node_metrics),
+                    "timestamp": rfc3339(snap.timestamp),
+                },
+                cors=True,
+            )
+
+        def h_metrics_node(self, node_name: str) -> None:
+            if not self._need_manager():
+                return
+            if not node_name:
+                return self._send_error_text("Node name is required", 400)
+            try:
+                node = srv.manager.get_node_metrics(node_name)
+            except KeyError as exc:
+                return self._send_error_text(f"Node not found: {exc}", 404)
+            self._send_json(
+                {"status": "success", "data": node, "timestamp": _now()}, cors=True
+            )
+
+        def h_metrics_pods(self) -> None:
+            if not self._need_manager():
+                return
+            snap = srv.manager.get_latest_snapshot()
+            self._send_json(
+                {
+                    "status": "success",
+                    "data": snap.pod_metrics,
+                    "count": len(snap.pod_metrics),
+                    "timestamp": rfc3339(snap.timestamp),
+                },
+                cors=True,
+            )
+
+        def h_metrics_snapshot(self) -> None:
+            if not self._need_manager():
+                return
+            self._send_json(
+                {"status": "success", "data": srv.manager.get_latest_snapshot()},
+                cors=True,
+            )
+
+        def h_metrics_network(self) -> None:
+            if not self._need_manager():
+                return
+            nets = srv.manager.get_network_metrics()
+            self._send_json(
+                {
+                    "status": "success",
+                    "data": nets,
+                    "count": len(nets),
+                    "timestamp": _now(),
+                },
+                cors=True,
+            )
+
+        def h_metrics_uav(self) -> None:
+            if not self._need_manager():
+                return
+            uavs = srv.manager.get_uav_metrics()
+            self._send_json(
+                {
+                    "status": "success",
+                    "data": uavs,
+                    "count": len(uavs),
+                    "timestamp": _now(),
+                },
+                cors=True,
+            )
+
+        def h_metrics_uav_node(self, node_name: str) -> None:
+            if not self._need_manager():
+                return
+            if not node_name:
+                return self._send_error_text("Node name is required", 400)
+            entry = srv.manager.get_single_uav_metrics(node_name)
+            if entry is None:
+                return self._send_error_text(
+                    f"UAV not found on node: {node_name}", 404
+                )
+            self._send_json(
+                {"status": "success", "data": entry, "timestamp": _now()}, cors=True
+            )
+
+        # -- UAV report ingestion (ref :569-645) --------------------------------
+
+        def h_uav_report(self) -> None:
+            try:
+                body = self._read_json() or {}
+            except json.JSONDecodeError:
+                return self._send_error_text("Invalid JSON body", 400)
+            node_name = body.get("node_name", "")
+            if not node_name:
+                return self._send_error_text("node_name is required", 400)
+            report = UAVReport(
+                node_name=node_name,
+                node_ip=body.get("node_ip", ""),
+                uav_id=body.get("uav_id") or f"uav-{node_name}",
+                source=body.get("source") or "agent",
+                status=body.get("status") or "active",
+                timestamp=parse_rfc3339(body.get("timestamp")) or utcnow(),
+                heartbeat_interval_seconds=int(
+                    body.get("heartbeat_interval_seconds", 0) or 0
+                ),
+                state=body.get("state"),
+                metadata=body.get("metadata") or {},
+            )
+            if srv.manager is not None:
+                srv.manager.update_uav_report(report)
+            else:
+                logger.warning(
+                    "metrics manager unavailable, skipping cache update for %s",
+                    node_name,
+                )
+            crd_status, crd_error = "unavailable", ""
+            if srv.client is not None:
+                try:
+                    srv.client.upsert_uav_metric("", report)
+                    crd_status = "updated"
+                except (ClusterError, ValueError) as exc:
+                    logger.warning("UAVMetric upsert failed for %s: %s", node_name, exc)
+                    crd_status, crd_error = "error", str(exc)
+            payload: dict[str, Any] = {
+                "status": "success",
+                "crd_status": crd_status,
+                "timestamp": _now(),
+                "node_name": report.node_name,
+                "uav_id": report.uav_id,
+                "uav_status": report.status,
+            }
+            if report.heartbeat_interval_seconds > 0:
+                payload["heartbeat_interval_seconds"] = (
+                    report.heartbeat_interval_seconds
+                )
+            if crd_error:
+                payload["message"] = crd_error
+            self._send_json(payload, cors=True)
+
+        # -- UAV CRD listing (ref :648-695) -------------------------------------
+
+        def h_uav_crd(self) -> None:
+            if srv.client is None:
+                return self._send_json(
+                    {"status": "error", "message": "K8s client not available"},
+                    status=503,
+                    cors=True,
+                )
+            query = parse_qs(urlparse(self.path).query)
+            namespace = (query.get("namespace", [""])[0] or "").strip()
+            if namespace.lower() == "all":
+                namespace = ""
+            try:
+                data = srv.client.list_uav_metrics_crd(namespace)
+            except ClusterError as exc:
+                logger.warning("failed to list UAV CRD data: %s", exc)
+                return self._send_json(
+                    {"status": "error", "message": str(exc)}, status=500, cors=True
+                )
+            self._send_json(
+                {
+                    "status": "success",
+                    "count": len(data),
+                    "data": data,
+                    "timestamp": _now(),
+                },
+                cors=True,
+            )
+
+    return Handler
+
+
+def build_server(
+    config: Config,
+    backend=None,
+    uav_fetcher=None,
+    web_dir: str | Path | None = None,
+) -> MonitorServer:
+    """Wire the full server from config: cluster backend → client → manager
+    → analysis engine → HTTP. ``backend=None`` boots dev mode (no cluster),
+    like the reference's nil-client path (cmd/server/main.go:43-51)."""
+    from k8s_llm_monitor_tpu.monitor.analysis import build_backend
+
+    client = None
+    manager = None
+    if backend is not None:
+        client = Client(
+            backend,
+            namespaces=config.k8s.watch_namespaces,
+            default_namespace=config.k8s.namespace,
+        )
+        try:
+            client.test_connection()
+        except ClusterError as exc:
+            logger.warning(
+                "cluster unreachable (%s) - running in development mode", exc
+            )
+            client = None
+    if client is not None and config.metrics.enabled:
+        manager = Manager(client, config.metrics, uav_fetcher=uav_fetcher)
+    llm_backend = build_backend(config.llm)
+    analysis = AnalysisEngine(
+        llm_backend,
+        client=client,
+        manager=manager,
+        cfg=config.analysis,
+        llm_cfg=config.llm,
+    )
+    return MonitorServer(
+        config=config,
+        client=client,
+        manager=manager,
+        analysis=analysis,
+        web_dir=web_dir,
+    )

@@ -30,7 +30,11 @@ class ByteTokenizer:
         return ([self.BOS] if add_bos else []) + ids
 
     def decode(self, ids: list[int]) -> str:
-        data = bytes(i - self.OFFSET for i in ids if i >= self.OFFSET)
+        # Ids beyond the byte range can appear when a model's vocab is larger
+        # than 259 (e.g. random-init dev weights); skip them like specials.
+        data = bytes(
+            i - self.OFFSET for i in ids if self.OFFSET <= i < self.OFFSET + 256
+        )
         return data.decode("utf-8", errors="replace")
 
 
