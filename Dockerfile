@@ -1,0 +1,25 @@
+# Monitor server image (parity: /root/reference/Dockerfile — multi-stage,
+# non-root, HEALTHCHECK; the runtime here is Python+JAX instead of a Go
+# binary, and for TPU serving the image expects the libtpu wheel to be
+# present on the TPU VM host or installed in a deploy-specific layer).
+FROM python:3.12-slim AS base
+
+WORKDIR /app
+RUN useradd --create-home --uid 10001 monitor \
+    && apt-get update && apt-get install -y --no-install-recommends curl \
+    && rm -rf /var/lib/apt/lists/*
+
+# Core deps; "jax[tpu]" replaces "jax" on TPU VMs.
+RUN pip install --no-cache-dir jax flax optax orbax-checkpoint einops \
+    numpy pyyaml transformers safetensors
+
+COPY k8s_llm_monitor_tpu/ k8s_llm_monitor_tpu/
+COPY web/ web/
+
+USER monitor
+EXPOSE 8081
+HEALTHCHECK --interval=30s --timeout=5s --start-period=30s \
+  CMD curl -sf http://localhost:8081/health || exit 1
+
+ENTRYPOINT ["python", "-m", "k8s_llm_monitor_tpu.cmd.server"]
+CMD ["--host", "0.0.0.0", "--port", "8081", "--cluster", "kube"]

@@ -1,0 +1,50 @@
+# Build/run targets (parity: /root/reference/Makefile, minus its broken
+# cmd/agent reference — the agent entrypoint here is cmd.uav_agent).
+
+PY ?= python
+TEST_ENV = env PYTHONPATH= JAX_PLATFORMS=cpu
+
+.PHONY: run run-agent run-scheduler demo test test-fast bench dryrun \
+        docker docker-agent docker-scheduler lint clean
+
+run:
+	$(PY) -m k8s_llm_monitor_tpu.cmd.server --cluster fake --port 8081
+
+run-agent:
+	$(PY) -m k8s_llm_monitor_tpu.cmd.uav_agent --port 9090
+
+run-scheduler:
+	$(PY) -m k8s_llm_monitor_tpu.cmd.scheduler --cluster fake
+
+demo:
+	$(PY) -m k8s_llm_monitor_tpu.cmd.demo debug-test
+
+test:
+	$(TEST_ENV) $(PY) -m pytest tests/ -q
+
+test-fast:          # monitor plane only (no jax compiles)
+	$(TEST_ENV) $(PY) -m pytest tests/ -q \
+	  --ignore=tests/test_model_parity.py \
+	  --ignore=tests/test_engine.py \
+	  --ignore=tests/test_sharding.py
+
+bench:
+	$(PY) bench.py
+
+dryrun:
+	env PYTHONPATH= $(PY) __graft_entry__.py 8
+
+docker:
+	docker build -t k8s-llm-monitor-tpu-server:dev -f Dockerfile .
+
+docker-agent:
+	docker build -t k8s-llm-monitor-tpu-agent:dev -f Dockerfile.agent .
+
+docker-scheduler:
+	docker build -t k8s-llm-monitor-tpu-scheduler:dev -f Dockerfile.scheduler .
+
+lint:
+	$(PY) -m compileall -q k8s_llm_monitor_tpu
+
+clean:
+	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

@@ -5,9 +5,14 @@ A from-scratch rebuild of the capability set of Sabre94/k8s-llm-monitor
 
 - ``monitor/``  — the Kubernetes control plane: cluster client (+ fake in-memory
   backend), watch machinery, metrics manager and sources, network analyzer with
-  RTT probing, CRD-driven battery-aware scheduler, UAV telemetry stack, and the
-  HTTP API + web dashboard.  Capability parity with the reference's Go code
-  (see SURVEY.md §2), re-derived in Python.
+  RTT probing, CRD-driven battery-aware scheduler, UAV telemetry stack, the
+  HTTP API (serving ``web/``'s dashboards), and the Analysis Engine the
+  reference only sketched (``monitor/analysis.py``: prompt assembly from
+  cluster evidence, root-cause / pod-communication / anomaly analyzers, and
+  the /api/v1/query NL endpoint backed by the local TPU engine).  Capability
+  parity with the reference's Go code (see SURVEY.md §2), re-derived in Python.
+- ``cmd/``      — executable entrypoints: server, uav_agent, scheduler,
+  test_k8s, demo.
 - ``models/``   — Llama-3 / Qwen2-family decoder LMs and a BGE-style embedding
   encoder, written as pure-functional JAX (pytree params, jit-compiled steps).
 - ``ops/``      — TPU compute primitives: RoPE, RMSNorm, fused attention with a
@@ -18,10 +23,6 @@ A from-scratch rebuild of the capability set of Sabre94/k8s-llm-monitor
   batching scheduler, streaming generation API.
 - ``training/`` — sharded train step (loss, grad, optax update) for
   fine-tuning the analysis models.
-- ``analysis/`` — the Analysis Engine the reference only sketched
-  (internal/config/config.go:141-145 is its entire LLM integration): prompt
-  assembly from cluster evidence, root-cause / pod-communication / anomaly
-  analyzers, and the /api/v1/query NL endpoint backed by the local TPU engine.
 """
 
 __version__ = "0.1.0"

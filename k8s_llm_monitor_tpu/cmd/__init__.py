@@ -1,0 +1,7 @@
+"""Executable entrypoints (``python -m k8s_llm_monitor_tpu.cmd.<name>``).
+
+Parity with the reference's cmd/ tree (``/root/reference/cmd/``):
+``server`` (cmd/server), ``uav_agent`` (cmd/uav-agent), ``scheduler``
+(cmd/scheduler), ``test_k8s`` (cmd/test-k8s), ``demo`` (the five
+cmd/demos/* walkthroughs as subcommands).
+"""

@@ -1,0 +1,93 @@
+"""Monitor API server entrypoint.
+
+Parity target: ``/root/reference/cmd/server/main.go:23-172`` — config
+load, cluster client with graceful dev-mode degradation (:43-51), metrics
+manager start (:82-87), route registration + serve, clean shutdown.
+
+Cluster selection:
+- ``--cluster fake``   : in-memory demo cluster (runs anywhere, like the
+                         reference's nil-client dev mode but with data)
+- ``--cluster kube``   : real API server via kubeconfig/in-cluster
+                         (stdlib REST client, monitor/kube_rest.py)
+- ``--cluster none``   : no cluster at all (pure degraded mode)
+
+Usage:
+    python -m k8s_llm_monitor_tpu.cmd.server --config config.yaml
+    python -m k8s_llm_monitor_tpu.cmd.server --cluster fake --port 8081
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import sys
+
+
+def main(argv: list[str] | None = None) -> int:
+    parser = argparse.ArgumentParser(description="k8s-llm-monitor TPU server")
+    parser.add_argument("--config", default="", help="config YAML path")
+    parser.add_argument("--host", default=None)
+    parser.add_argument("--port", type=int, default=None)
+    parser.add_argument(
+        "--cluster",
+        choices=("fake", "kube", "none"),
+        default="fake",
+        help="cluster backend (default: fake demo cluster)",
+    )
+    parser.add_argument("--kubeconfig", default="", help="kubeconfig path for --cluster kube")
+    parser.add_argument(
+        "--llm",
+        default="",
+        help="override llm.provider (tpu | openai | template)",
+    )
+    args = parser.parse_args(argv)
+
+    from k8s_llm_monitor_tpu.monitor.config import load_config
+    from k8s_llm_monitor_tpu.monitor.server import build_server
+
+    config = load_config(args.config or None)
+    logging.basicConfig(
+        level=logging.DEBUG if config.server.debug else logging.INFO,
+        format="%(asctime)s %(levelname)s %(name)s %(message)s",
+    )
+    log = logging.getLogger("cmd.server")
+    if args.host is not None:
+        config.server.host = args.host
+    if args.port is not None:
+        config.server.port = args.port
+    if args.llm:
+        config.llm.provider = args.llm
+
+    backend = None
+    if args.cluster == "fake":
+        from k8s_llm_monitor_tpu.monitor.cluster import FakeCluster, seed_demo_cluster
+
+        backend = seed_demo_cluster(FakeCluster())
+        log.info("using in-memory demo cluster")
+    elif args.cluster == "kube":
+        from k8s_llm_monitor_tpu.monitor.kube_rest import KubeRestBackend
+
+        try:
+            backend = KubeRestBackend.from_kubeconfig(
+                args.kubeconfig or config.k8s.kubeconfig or None
+            )
+        except Exception as exc:  # noqa: BLE001 — dev-mode degradation
+            log.warning("cluster unreachable (%s) - development mode", exc)
+            backend = None
+
+    srv = build_server(config, backend=backend)
+    if srv.manager is not None:
+        srv.manager.start()
+        log.info(
+            "metrics manager started (interval %ds)", config.metrics.collect_interval
+        )
+    try:
+        srv.serve_forever()
+    finally:
+        if srv.manager is not None:
+            srv.manager.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

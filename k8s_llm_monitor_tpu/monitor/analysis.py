@@ -176,7 +176,7 @@ class LocalEngineBackend(LLMBackend):
                         sampling=sampling,
                     )
                 )
-            while self.engine.has_work():
+            while self.engine.has_work:
                 self.engine.step()
             out = []
             for rid in ids:
@@ -422,7 +422,10 @@ class AnalysisEngine:
         except Exception as exc:  # noqa: BLE001 — API boundary
             logger.exception("query failed")
             return AnalysisResponse(
-                request_id=request_id, status="error", error=str(exc)
+                request_id=request_id,
+                status="error",
+                error=str(exc),
+                error_kind="internal",
             )
 
     # -- typed analyses (ref pkg/models/models.go:85-99) ------------------------
@@ -435,6 +438,7 @@ class AnalysisEngine:
                 status="error",
                 error=f"unknown analysis type {request.type!r}; "
                 f"expected one of {list(ANALYSIS_TYPES)}",
+                error_kind="validation",
             )
         try:
             handler = {
@@ -446,10 +450,20 @@ class AnalysisEngine:
             return AnalysisResponse(
                 request_id=request_id, status="success", result=result
             )
+        except ValueError as exc:  # bad parameters from the caller
+            return AnalysisResponse(
+                request_id=request_id,
+                status="error",
+                error=str(exc),
+                error_kind="validation",
+            )
         except Exception as exc:  # noqa: BLE001 — API boundary
             logger.exception("analysis %s failed", request.type)
             return AnalysisResponse(
-                request_id=request_id, status="error", error=str(exc)
+                request_id=request_id,
+                status="error",
+                error=str(exc),
+                error_kind="internal",
             )
 
     def _analyze_pod_communication(self, params: dict[str, Any]) -> dict[str, Any]:

@@ -101,7 +101,9 @@ class Manager:
 
     def start(self) -> None:
         if self._thread is not None:
-            return
+            if self._thread.is_alive():
+                return
+            self._thread = None  # previous loop finished after a timed-out stop
         self._stop.clear()
         self._thread = threading.Thread(
             target=self._loop, name="metrics-manager", daemon=True

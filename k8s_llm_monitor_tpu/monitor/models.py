@@ -175,6 +175,9 @@ class AnalysisResponse:
     status: str = ""  # success | error | processing
     result: dict[str, Any] = field(default_factory=dict)
     error: str = field(default="", metadata=omitempty())
+    # "validation" (caller's request is bad) vs "internal" (server-side
+    # failure) — lets the API layer pick 4xx vs 5xx correctly
+    error_kind: str = field(default="", metadata=omitempty())
     timestamp: datetime = field(default_factory=utcnow)
 
 

@@ -21,7 +21,7 @@ import mimetypes
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
-from typing import Any, Callable
+from typing import Any
 from urllib.parse import parse_qs, urlparse
 
 from k8s_llm_monitor_tpu.monitor.analysis import AnalysisEngine
@@ -109,6 +109,27 @@ class MonitorServer:
             self._httpd.server_close()
 
 
+# method-name route table, static across requests (bound per request via
+# getattr because handler instances are created per connection)
+_ROUTES: dict[tuple[str, str], str] = {
+    ("GET", "/health"): "h_health",
+    ("GET", "/api/v1/cluster/status"): "h_cluster_status",
+    ("GET", "/api/v1/pods"): "h_pods",
+    ("POST", "/api/v1/analyze/pod-communication"): "h_pod_comm",
+    ("POST", "/api/v1/analyze"): "h_analyze",
+    ("POST", "/api/v1/query"): "h_query",
+    ("GET", "/api/v1/metrics/cluster"): "h_metrics_cluster",
+    ("GET", "/api/v1/metrics/nodes"): "h_metrics_nodes",
+    ("GET", "/api/v1/metrics/pods"): "h_metrics_pods",
+    ("GET", "/api/v1/metrics/snapshot"): "h_metrics_snapshot",
+    ("GET", "/api/v1/metrics/network"): "h_metrics_network",
+    ("GET", "/api/v1/metrics/uav"): "h_metrics_uav",
+    ("POST", "/api/v1/uav/report"): "h_uav_report",
+    ("GET", "/api/v1/crd/uav"): "h_uav_crd",
+}
+_ROUTE_PATHS = {p for _, p in _ROUTES}
+
+
 def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -140,10 +161,18 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
             self.end_headers()
             self.wfile.write(body)
 
-        def _read_json(self) -> Any:
+        def _read_json(self) -> dict[str, Any]:
+            """Parse the body as a JSON object; raises ValueError (which
+            json.JSONDecodeError subclasses) for non-JSON and for valid JSON
+            that isn't an object — both are the caller's fault (400)."""
             length = int(self.headers.get("Content-Length", 0) or 0)
             raw = self.rfile.read(length) if length else b""
-            return json.loads(raw) if raw else None
+            if not raw:
+                return {}
+            data = json.loads(raw)
+            if not isinstance(data, dict):
+                raise ValueError("JSON body must be an object")
+            return data
 
         # -- routing ----------------------------------------------------------
 
@@ -157,26 +186,9 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
             parsed = urlparse(self.path)
             path = parsed.path
             try:
-                routes: list[tuple[str, str, Callable[..., None]]] = [
-                    ("GET", "/health", self.h_health),
-                    ("GET", "/api/v1/cluster/status", self.h_cluster_status),
-                    ("GET", "/api/v1/pods", self.h_pods),
-                    ("POST", "/api/v1/analyze/pod-communication", self.h_pod_comm),
-                    ("POST", "/api/v1/analyze", self.h_analyze),
-                    ("POST", "/api/v1/query", self.h_query),
-                    ("GET", "/api/v1/metrics/cluster", self.h_metrics_cluster),
-                    ("GET", "/api/v1/metrics/nodes", self.h_metrics_nodes),
-                    ("GET", "/api/v1/metrics/pods", self.h_metrics_pods),
-                    ("GET", "/api/v1/metrics/snapshot", self.h_metrics_snapshot),
-                    ("GET", "/api/v1/metrics/network", self.h_metrics_network),
-                    ("GET", "/api/v1/metrics/uav", self.h_metrics_uav),
-                    ("POST", "/api/v1/uav/report", self.h_uav_report),
-                    ("GET", "/api/v1/crd/uav", self.h_uav_crd),
-                ]
-                exact = {(m, p): h for m, p, h in routes}
-                paths = {p for _, p, _ in routes}
-                if (method, path) in exact:
-                    return exact[(method, path)]()
+                handler_name = _ROUTES.get((method, path))
+                if handler_name is not None:
+                    return getattr(self, handler_name)()
                 # prefix routes with a path parameter
                 if path.startswith("/api/v1/metrics/nodes/"):
                     if method != "GET":
@@ -186,7 +198,7 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
                     if method != "GET":
                         return self._send_error_text("Method not allowed", 405)
                     return self.h_metrics_uav_node(path[len("/api/v1/metrics/uav/") :])
-                if path in paths:
+                if path in _ROUTE_PATHS:
                     # registered path, wrong method (ref per-handler checks)
                     return self._send_error_text("Method not allowed", 405)
                 if method == "GET":
@@ -207,7 +219,7 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
             rel = path.lstrip("/") or "index.html"
             base = srv.web_dir.resolve()
             target = (base / rel).resolve()
-            if not str(target).startswith(str(base)) or not target.is_file():
+            if not target.is_relative_to(base) or not target.is_file():
                 return self._send_error_text("404 page not found", 404)
             ctype = mimetypes.guess_type(str(target))[0] or "application/octet-stream"
             data = target.read_bytes()
@@ -275,7 +287,7 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
                 )
             try:
                 body = self._read_json() or {}
-            except json.JSONDecodeError:
+            except ValueError:
                 return self._send_error_text("Invalid JSON body", 400)
             pod_a, pod_b = body.get("pod_a", ""), body.get("pod_b", "")
             if not pod_a or not pod_b:
@@ -321,7 +333,7 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
                 )
             try:
                 body = self._read_json() or {}
-            except json.JSONDecodeError:
+            except ValueError:
                 return self._send_error_text("Invalid JSON body", 400)
             question = (body.get("question") or "").strip()
             if not question:
@@ -337,7 +349,7 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
                 )
             try:
                 body = self._read_json() or {}
-            except json.JSONDecodeError:
+            except ValueError:
                 return self._send_error_text("Invalid JSON body", 400)
             req = AnalysisRequest(
                 type=body.get("type", ""),
@@ -345,7 +357,11 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
                 context=body.get("context") or {},
             )
             resp = srv.analysis.analyze(req)
-            self._send_json(resp, status=200 if resp.status == "success" else 400)
+            if resp.status == "success":
+                return self._send_json(resp)
+            # validation errors are the caller's fault; everything else is a
+            # server-side failure monitoring clients should retry on
+            self._send_json(resp, status=400 if resp.error_kind == "validation" else 500)
 
         # -- metrics handlers (CORS like ref :328) ------------------------------
 
@@ -463,11 +479,17 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
         def h_uav_report(self) -> None:
             try:
                 body = self._read_json() or {}
-            except json.JSONDecodeError:
+            except ValueError:
                 return self._send_error_text("Invalid JSON body", 400)
             node_name = body.get("node_name", "")
             if not node_name:
                 return self._send_error_text("node_name is required", 400)
+            try:
+                heartbeat = int(body.get("heartbeat_interval_seconds", 0) or 0)
+            except (TypeError, ValueError):
+                return self._send_error_text(
+                    "heartbeat_interval_seconds must be a number", 400
+                )
             report = UAVReport(
                 node_name=node_name,
                 node_ip=body.get("node_ip", ""),
@@ -475,9 +497,7 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
                 source=body.get("source") or "agent",
                 status=body.get("status") or "active",
                 timestamp=parse_rfc3339(body.get("timestamp")) or utcnow(),
-                heartbeat_interval_seconds=int(
-                    body.get("heartbeat_interval_seconds", 0) or 0
-                ),
+                heartbeat_interval_seconds=heartbeat,
                 state=body.get("state"),
                 metadata=body.get("metadata") or {},
             )

@@ -1,0 +1,60 @@
+#!/usr/bin/env bash
+# End-to-end UAV pipeline check against a running monitor server
+# (parity: /root/reference/scripts/test_uav_collection.sh — curl/jq
+# verification of report ingestion, cache reads, and the CRD record).
+#
+# Usage: ./scripts/test_uav_collection.sh [base-url]   (default :8081)
+set -euo pipefail
+
+BASE="${1:-http://127.0.0.1:8081}"
+PASS=0; FAIL=0
+
+check() {  # check <name> <cmd...>
+  local name="$1"; shift
+  if "$@" >/dev/null 2>&1; then
+    echo "  PASS $name"; PASS=$((PASS+1))
+  else
+    echo "  FAIL $name"; FAIL=$((FAIL+1))
+  fi
+}
+
+json() { curl -sf "$BASE$1"; }
+
+echo "== 1. server health =="
+check "/health" curl -sf "$BASE/health"
+
+echo "== 2. report ingestion =="
+REPORT='{"node_name":"script-node","node_ip":"10.0.0.9","uav_id":"uav-script",
+  "heartbeat_interval_seconds":10,
+  "state":{"gps":{"latitude":39.9,"longitude":116.4,"altitude":55},
+  "battery":{"voltage":21.8,"remaining_percent":72.5},
+  "flight":{"mode":"AUTO","armed":true},
+  "health":{"system_status":"OK"}}}'
+check "POST /api/v1/uav/report" \
+  curl -sf -X POST -H 'Content-Type: application/json' -d "$REPORT" \
+  "$BASE/api/v1/uav/report"
+
+echo "== 3. cache reads =="
+check "uav list contains node" \
+   bash -c "curl -sf $BASE/api/v1/metrics/uav | grep -q script-node"
+check "single uav entry" curl -sf "$BASE/api/v1/metrics/uav/script-node"
+check "battery value present" \
+   bash -c "curl -sf $BASE/api/v1/metrics/uav/script-node | grep -q 72.5"
+
+echo "== 4. CRD record =="
+check "uavmetric CR exists" \
+   bash -c "curl -sf $BASE/api/v1/crd/uav | grep -q uavmetric-script-node"
+
+echo "== 5. metrics plane =="
+check "cluster metrics" curl -sf "$BASE/api/v1/metrics/cluster"
+check "nodes metrics" curl -sf "$BASE/api/v1/metrics/nodes"
+check "snapshot" curl -sf "$BASE/api/v1/metrics/snapshot"
+
+echo "== 6. analysis engine =="
+check "NL query" \
+  curl -sf -X POST -H 'Content-Type: application/json' \
+  -d '{"question":"is the uav fleet healthy?"}' "$BASE/api/v1/query"
+
+echo
+echo "passed $PASS, failed $FAIL"
+[ "$FAIL" -eq 0 ]

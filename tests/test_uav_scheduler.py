@@ -1,0 +1,338 @@
+"""UAV simulator/agent + scheduler controller tests
+(ref pkg/uav/mavlink_simulator.go, cmd/uav-agent/main.go,
+internal/scheduler/controller.go)."""
+
+import json
+import urllib.request
+
+import pytest
+
+from k8s_llm_monitor_tpu.monitor.agent import UAVAgent
+from k8s_llm_monitor_tpu.monitor.client import Client
+from k8s_llm_monitor_tpu.monitor.cluster import FakeCluster
+from k8s_llm_monitor_tpu.monitor.models import UAVReport
+from k8s_llm_monitor_tpu.monitor.scheduler import SchedulerConfig, SchedulerController
+from k8s_llm_monitor_tpu.monitor.uav import MAVLinkSimulator
+
+
+# ---------------------------------------------------------------------------
+# simulator
+# ---------------------------------------------------------------------------
+
+
+def test_simulator_initial_state():
+    sim = MAVLinkSimulator("uav-1", "node-1", seed=42)
+    s = sim.get_state()
+    assert s["uav_id"] == "uav-1"
+    assert s["gps"]["fix_type"] == 3
+    assert s["battery"]["remaining_percent"] == 100.0
+    assert s["battery"]["cell_count"] == 6
+    assert s["flight"]["mode"] == "STABILIZE"
+    assert not s["flight"]["armed"]
+    assert s["health"]["system_status"] == "OK"
+    assert s["health"]["sensors_health"]["gps"] is True
+
+
+def test_simulator_flight_dynamics():
+    sim = MAVLinkSimulator("uav-1", "node-1", seed=42)
+    assert sim.arm()
+    assert sim.take_off(60.0)
+    s0 = sim.get_state()
+    for _ in range(50):  # 5 simulated seconds
+        sim.tick(0.1)
+    s1 = sim.get_state()
+    assert s1["flight"]["mode"] == "AUTO"
+    assert s1["flight"]["armed"]
+    # circular path moves GPS, battery discharges ~0.1%/s
+    assert s1["gps"]["latitude"] != s0["gps"]["latitude"]
+    assert s1["gps"]["ground_speed"] > 4.5
+    assert 99.0 < s1["battery"]["remaining_percent"] < 100.0
+    assert s1["battery"]["voltage"] < 22.2
+    assert s1["flight"]["throttle_percent"] > 0
+
+
+def test_simulator_battery_health_transitions():
+    sim = MAVLinkSimulator("uav-1", "node-1", seed=1)
+    sim.arm()
+    sim.take_off()
+    sim.set_battery_percent(19.0)
+    sim.tick(0.1)
+    s = sim.get_state()
+    assert s["health"]["system_status"] == "WARNING"
+    assert s["health"]["warning_count"] == 1
+    assert any("Low battery" in m for m in s["health"]["messages"])
+
+    sim.set_battery_percent(9.0)
+    sim.tick(0.1)
+    s = sim.get_state()
+    assert s["health"]["system_status"] == "CRITICAL"
+    assert any("Critical battery" in m for m in s["health"]["messages"])
+
+
+def test_simulator_arm_requires_gps_fix():
+    sim = MAVLinkSimulator("uav-1", "node-1", seed=1)
+    sim._state.gps.fix_type = 0
+    assert not sim.arm()
+    assert not sim.get_state()["flight"]["armed"]
+    # takeoff refused while disarmed
+    assert not sim.take_off()
+
+
+def test_simulator_message_ring_bounded():
+    sim = MAVLinkSimulator("uav-1", "node-1")
+    for i in range(25):
+        sim.set_flight_mode(f"MODE{i}")
+    assert len(sim.get_state()["health"]["messages"]) == 10
+
+
+# ---------------------------------------------------------------------------
+# agent
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def agent():
+    posted = []
+    a = UAVAgent(
+        node_name="node-1",
+        node_ip="10.0.0.1",
+        port=0,
+        master_url="http://master:8081",
+        report_interval=3600,
+        poster=lambda url, payload: posted.append((url, payload)),
+    )
+    a.start()
+    yield a, posted
+    a.stop()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return r.status, json.loads(r.read())
+
+
+def _post(port, path, payload=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload or {}).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_agent_http_surface(agent):
+    a, _ = agent
+    _, health = _get(a.port, "/health")
+    assert health["status"] == "healthy"
+    assert health["uav_id"] == "uav-node-1"
+
+    _, state = _get(a.port, "/api/v1/state")
+    assert state["node_name"] == "node-1"
+    for sub in ("gps", "attitude", "battery", "flight"):
+        _, part = _get(a.port, f"/api/v1/{sub}")
+        assert part == state[sub] or set(part) == set(state[sub])
+
+
+def test_agent_command_endpoints(agent):
+    a, _ = agent
+    _, res = _post(a.port, "/api/v1/command/arm")
+    assert res["status"] == "success"
+    _, res = _post(a.port, "/api/v1/command/takeoff", {"altitude": 80})
+    assert res["status"] == "success"
+    assert a.simulator.get_state()["flight"]["mode"] == "AUTO"
+    _, res = _post(a.port, "/api/v1/command/mode", {"mode": "LOITER"})
+    assert a.simulator.get_state()["flight"]["mode"] == "LOITER"
+    _, res = _post(a.port, "/api/v1/command/rtl")
+    assert a.simulator.get_state()["flight"]["mode"] == "RTL"
+    _, res = _post(a.port, "/api/v1/command/land")
+    assert a.simulator.get_state()["flight"]["mode"] == "LAND"
+    _, res = _post(a.port, "/api/v1/command/disarm")
+    assert not a.simulator.get_state()["flight"]["armed"]
+
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _post(a.port, "/api/v1/command/explode")
+    assert err.value.code == 404
+
+
+def test_agent_report_push(agent):
+    a, posted = agent
+    # first report fires immediately on start
+    import time
+
+    deadline = time.monotonic() + 5
+    while not posted and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert posted
+    url, payload = posted[0]
+    assert url == "http://master:8081/api/v1/uav/report"
+    assert payload["node_name"] == "node-1"
+    assert payload["node_ip"] == "10.0.0.1"
+    assert payload["uav_id"] == "uav-node-1"
+    assert payload["source"] == "agent"
+    assert payload["heartbeat_interval_seconds"] == 3600
+    assert payload["state"]["battery"]["remaining_percent"] == 100.0
+
+
+# ---------------------------------------------------------------------------
+# scheduler
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def sched_world():
+    fake = FakeCluster()
+    fake.add_node("node-a")
+    fake.add_node("node-b")
+    fake.add_node("node-tpu", tpu_chips=4)
+    fake.define_crd("monitoring.io", "UAVMetric", "uavmetrics")
+    fake.define_crd("scheduler.io", "SchedulingRequest", "schedulingrequests")
+    client = Client(fake, namespaces=["default"])
+    return fake, client
+
+
+def _push_uav(client, node, battery, status="active"):
+    client.upsert_uav_metric(
+        "",
+        UAVReport(
+            node_name=node,
+            uav_id=f"uav-{node}",
+            status=status,
+            state={
+                "gps": {"latitude": 1.0},
+                "battery": {"remaining_percent": battery},
+                "flight": {"mode": "AUTO"},
+                "health": {"system_status": "OK"},
+            },
+        ),
+    )
+
+
+def _make_request(fake, name, workload="job-1", min_battery=None, preferred=None):
+    spec = {"workload": {"name": workload, "namespace": "default"}}
+    if min_battery is not None:
+        spec["minBatteryPercent"] = min_battery
+    if preferred:
+        spec["preferredNodes"] = preferred
+    return fake.create_custom_resource(
+        "scheduler.io",
+        "v1",
+        "schedulingrequests",
+        "default",
+        {"metadata": {"name": name}, "spec": spec},
+    )
+
+
+def _get_request(fake, name):
+    return fake.get_custom_resource(
+        "scheduler.io", "v1", "schedulingrequests", "default", name
+    )
+
+
+def test_scheduler_assigns_best_battery(sched_world):
+    fake, client = sched_world
+    _push_uav(client, "node-a", 90.0)
+    _push_uav(client, "node-b", 60.0)
+    _make_request(fake, "req-1")
+    ctrl = SchedulerController(client, SchedulerConfig(tpu_node_bonus=0))
+    assert ctrl.reconcile() == 1
+    req = _get_request(fake, "req-1")
+    assert req["status"]["phase"] == "Assigned"
+    assert req["status"]["assignedNode"] == "node-a"
+    assert req["status"]["assignedUAV"] == "uav-node-a"
+    assert req["status"]["score"] == 90.0
+
+
+def test_scheduler_preferred_node_bonus(sched_world):
+    fake, client = sched_world
+    _push_uav(client, "node-a", 90.0)
+    _push_uav(client, "node-b", 85.0)
+    _make_request(fake, "req-2", preferred=["node-b"])
+    ctrl = SchedulerController(client, SchedulerConfig(tpu_node_bonus=0))
+    ctrl.reconcile()
+    req = _get_request(fake, "req-2")
+    # 85 + 10 bonus beats 90
+    assert req["status"]["assignedNode"] == "node-b"
+    assert req["status"]["score"] == 95.0
+
+
+def test_scheduler_tpu_node_bonus(sched_world):
+    fake, client = sched_world
+    _push_uav(client, "node-a", 88.0)
+    _push_uav(client, "node-tpu", 85.0)
+    _make_request(fake, "req-tpu")
+    ctrl = SchedulerController(client, SchedulerConfig(tpu_node_bonus=5.0))
+    ctrl.reconcile()
+    req = _get_request(fake, "req-tpu")
+    assert req["status"]["assignedNode"] == "node-tpu"  # 85+5 > 88
+
+
+def test_scheduler_filters(sched_world):
+    fake, client = sched_world
+    _push_uav(client, "node-a", 25.0)  # below default min battery
+    _push_uav(client, "node-b", 80.0, status="stale")  # inactive
+    _make_request(fake, "req-3")
+    ctrl = SchedulerController(client)
+    ctrl.reconcile()
+    req = _get_request(fake, "req-3")
+    assert req["status"]["phase"] == "Failed"
+    assert "no active UAV" in req["status"]["message"]
+
+
+def test_scheduler_invalid_workload(sched_world):
+    fake, client = sched_world
+    fake.create_custom_resource(
+        "scheduler.io",
+        "v1",
+        "schedulingrequests",
+        "default",
+        {"metadata": {"name": "bad"}, "spec": {"workload": {"name": ""}}},
+    )
+    ctrl = SchedulerController(client)
+    ctrl.reconcile()
+    req = _get_request(fake, "bad")
+    assert req["status"]["phase"] == "Failed"
+    assert "required" in req["status"]["message"]
+
+
+def test_scheduler_skips_settled_requests(sched_world):
+    fake, client = sched_world
+    _push_uav(client, "node-a", 90.0)
+    _make_request(fake, "req-4")
+    ctrl = SchedulerController(client, SchedulerConfig(tpu_node_bonus=0))
+    assert ctrl.reconcile() == 1
+    # second pass must not reprocess the Assigned request
+    assert ctrl.reconcile() == 0
+
+
+def test_agent_to_scheduler_end_to_end(sched_world):
+    """Simulator-fed report → CRD upsert → scheduling request → Assigned."""
+    fake, client = sched_world
+    agent = UAVAgent(
+        node_name="node-a",
+        port=0,
+        master_url="http://master",
+        report_interval=3600,
+        poster=lambda url, payload: client.upsert_uav_metric(
+            "", UAVReport(**{
+                k: v for k, v in payload.items()
+                if k in ("node_name", "uav_id", "source", "status", "state")
+            })
+        ),
+    )
+    agent.start()
+    try:
+        import time
+
+        deadline = time.monotonic() + 5
+        while agent.reports_sent == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        _make_request(fake, "req-e2e")
+        ctrl = SchedulerController(client)
+        ctrl.reconcile()
+        req = _get_request(fake, "req-e2e")
+        assert req["status"]["phase"] == "Assigned"
+        assert req["status"]["assignedNode"] == "node-a"
+    finally:
+        agent.stop()
