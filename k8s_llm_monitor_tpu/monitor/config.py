@@ -87,8 +87,8 @@ class StorageConfig:
 @dataclass
 class MonitoringConfig:
     metrics_interval: int = 30
-    event_retention: int = 1000
-    log_retention: int = 1000
+    event_retention: int = 168  # hours (ref config.go default)
+    log_retention: int = 24  # hours (ref config.go default)
 
 
 @dataclass
@@ -108,7 +108,7 @@ class MetricsConfig:
 
 @dataclass
 class AnalysisConfig:
-    enable_prediction: bool = False
+    enable_prediction: bool = True  # ref config.go default
     enable_auto_fix: bool = False
     max_context_events: int = 100
 
@@ -116,7 +116,7 @@ class AnalysisConfig:
 @dataclass
 class LoggingConfig:
     level: str = "info"
-    format: str = "text"
+    format: str = "json"  # ref config.go default
     output: str = "stdout"
 
 

@@ -38,13 +38,11 @@ from k8s_llm_monitor_tpu.monitor.models import (
 logger = logging.getLogger("monitor.scheduler")
 
 PREFERRED_NODE_BONUS = 10.0  # ref controller.go:205-208
-DEFAULT_MIN_BATTERY = 30.0
 
 
 @dataclass
 class SchedulerConfig:
     interval: float = 15.0  # ref cmd/scheduler/main.go:24 default
-    default_min_battery: float = DEFAULT_MIN_BATTERY
     tpu_node_bonus: float = 5.0  # extension: prefer TPU-carrying nodes
 
 
@@ -126,10 +124,10 @@ class SchedulerController:
             self.failed_count += 1
             return
 
-        min_battery = float(
-            spec.get("minBatteryPercent") or self.cfg.default_min_battery
-        )
-        preferred = set(spec.get("preferredNodes") or [])
+        # Reference semantics (controller.go:174-221): no battery filter at
+        # all when minBatteryPercent is absent or 0 — no silent default floor.
+        min_battery = float(spec.get("minBatteryPercent") or 0.0)
+        preferred = {str(n).lower() for n in (spec.get("preferredNodes") or [])}
         candidates = self._build_candidates(uav_metrics, min_battery, preferred)
 
         if not candidates:
@@ -182,12 +180,16 @@ class SchedulerController:
             )
             if not node:
                 continue
-            if status.get("collection_status") != "active":
-                continue  # ref :198-200
-            if battery < min_battery:
+            # Ref :198-200: only explicit non-"active" values disqualify —
+            # an empty/missing collection_status is accepted; the comparison
+            # is case-insensitive.
+            cstatus = str(status.get("collection_status") or "")
+            if cstatus and cstatus.lower() != "active":
+                continue
+            if min_battery > 0 and battery < min_battery:
                 continue
             score = battery
-            if node in preferred:
+            if node.lower() in preferred:
                 score += PREFERRED_NODE_BONUS
             if node in tpu_nodes:
                 score += self.cfg.tpu_node_bonus

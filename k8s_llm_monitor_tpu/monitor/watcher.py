@@ -97,13 +97,18 @@ class Watcher:
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
+        # Snapshot under the lock: watch threads may append to these lists
+        # concurrently, and iterating while another thread mutates can raise
+        # or skip an entry (leaving a thread never joined).
         with self._lock:
             streams = list(self._streams)
+            threads = list(self._threads)
         for s in streams:
             s.close()
-        for t in self._threads:
+        for t in threads:
             t.join(timeout=timeout)
-        self._threads.clear()
+        with self._lock:
+            self._threads.clear()
 
     def _register(self, stream: WatchStream) -> None:
         # Close immediately if stop() ran between watch() and registration,
@@ -214,13 +219,18 @@ class CRDWatcher:
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
+        # Snapshot under the lock: watch threads may append to these lists
+        # concurrently, and iterating while another thread mutates can raise
+        # or skip an entry (leaving a thread never joined).
         with self._lock:
             streams = list(self._streams)
+            threads = list(self._threads)
         for s in streams:
             s.close()
-        for t in self._threads:
+        for t in threads:
             t.join(timeout=timeout)
-        self._threads.clear()
+        with self._lock:
+            self._threads.clear()
 
     def _register(self, stream: WatchStream) -> None:
         with self._lock:
@@ -244,18 +254,20 @@ class CRDWatcher:
                 self._ensure_cr_watch(raw)
 
     def _ensure_cr_watch(self, raw_crd: dict[str, Any]) -> None:
+        if self._stop.is_set():
+            return  # shutting down — never spawn a watch stop() could miss
         name = raw_crd.get("metadata", {}).get("name", "")
-        with self._lock:
-            if name in self._cr_watched:
-                return
-            self._cr_watched.add(name)
         t = threading.Thread(
             target=self._cr_watch_loop,
             args=(raw_crd,),
             name=f"watch-cr-{name}",
             daemon=True,
         )
-        self._threads.append(t)
+        with self._lock:
+            if name in self._cr_watched:
+                return
+            self._cr_watched.add(name)
+            self._threads.append(t)
         t.start()
 
     # -- watch loops ----------------------------------------------------------

@@ -270,14 +270,40 @@ def test_scheduler_tpu_node_bonus(sched_world):
 
 def test_scheduler_filters(sched_world):
     fake, client = sched_world
-    _push_uav(client, "node-a", 25.0)  # below default min battery
-    _push_uav(client, "node-b", 80.0, status="stale")  # inactive
-    _make_request(fake, "req-3")
+    _push_uav(client, "node-a", 25.0)  # below requested min battery
+    _push_uav(client, "node-b", 80.0, status="stale")  # explicit inactive
+    _make_request(fake, "req-3", min_battery=30)
     ctrl = SchedulerController(client)
     ctrl.reconcile()
     req = _get_request(fake, "req-3")
     assert req["status"]["phase"] == "Failed"
     assert "no active UAV" in req["status"]["message"]
+
+
+def test_scheduler_no_battery_filter_when_unset(sched_world):
+    """Ref controller.go:174-221: minBatteryPercent absent/0 = no filter."""
+    fake, client = sched_world
+    _push_uav(client, "node-a", 5.0)
+    _make_request(fake, "req-nofilter")
+    ctrl = SchedulerController(client, SchedulerConfig(tpu_node_bonus=0))
+    ctrl.reconcile()
+    req = _get_request(fake, "req-nofilter")
+    assert req["status"]["phase"] == "Assigned"
+    assert req["status"]["assignedNode"] == "node-a"
+
+
+def test_scheduler_accepts_empty_collection_status_and_case(sched_world):
+    """Empty collection_status is accepted; "Active" compares lowercased;
+    preferred-node matching is case-insensitive (ref :198-208)."""
+    fake, client = sched_world
+    _push_uav(client, "node-a", 70.0, status="")  # empty -> accepted
+    _push_uav(client, "node-b", 70.0, status="Active")  # case-insensitive
+    _make_request(fake, "req-ci", preferred=["NODE-B"])
+    ctrl = SchedulerController(client, SchedulerConfig(tpu_node_bonus=0))
+    ctrl.reconcile()
+    req = _get_request(fake, "req-ci")
+    assert req["status"]["phase"] == "Assigned"
+    assert req["status"]["assignedNode"] == "node-b"  # 70+10 beats 70
 
 
 def test_scheduler_invalid_workload(sched_world):
