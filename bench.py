@@ -59,12 +59,14 @@ def main() -> None:
 
     params = llama.init_params(jax.random.PRNGKey(0), cfg)
     ecfg = EngineConfig(
-        max_slots=32,
-        num_blocks=2048,
+        max_slots=128,
+        num_blocks=4096,
         block_size=16,
         max_blocks_per_seq=32,
         prefill_buckets=(256,),
-        max_prefills_per_step=4,
+        max_prefills_per_step=16,
+        max_admission_rounds=8,
+        decode_steps_per_iter=8,
     )
     eng = InferenceEngine(cfg, params, ecfg, eos_id=-1)
 
@@ -73,11 +75,14 @@ def main() -> None:
     def prompt() -> list[int]:
         return list(rng.integers(4, cfg.vocab_size - 4, size=prompt_len))
 
-    # Warm up every compiled shape (prefill bucket, decode step, sampler) so
-    # measured TTFT excludes compile time.
+    # Warm up every compiled shape — batched (P=16) and single (P=1) prefill,
+    # and the fused-decode K ladder (8, 4, 2, 1) the drain will walk — so the
+    # measured run excludes compile time.
     log("warmup (compiles prefill/decode)...")
     wt0 = time.monotonic()
-    eng.generate([prompt() for _ in range(2)], SamplingParams(max_tokens=3))
+    eng.generate([prompt() for _ in range(2)],
+                 SamplingParams(max_tokens=max_tokens))
+    eng.generate([prompt()], SamplingParams(max_tokens=4))
     log(f"warmup done in {time.monotonic() - wt0:.1f}s")
 
     # --- concurrent burst: all requests queued at t=0, engine drains ---

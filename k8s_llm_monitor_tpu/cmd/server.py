@@ -65,12 +65,13 @@ def main(argv: list[str] | None = None) -> int:
         backend = seed_demo_cluster(FakeCluster())
         log.info("using in-memory demo cluster")
     elif args.cluster == "kube":
-        from k8s_llm_monitor_tpu.monitor.kube_rest import KubeRestBackend
-
         try:
+            from k8s_llm_monitor_tpu.monitor.kube_rest import KubeRestBackend
+
             backend = KubeRestBackend.from_kubeconfig(
                 args.kubeconfig or config.k8s.kubeconfig or None
             )
+            backend.server_version()  # fail fast if unreachable
         except Exception as exc:  # noqa: BLE001 — dev-mode degradation
             log.warning("cluster unreachable (%s) - development mode", exc)
             backend = None

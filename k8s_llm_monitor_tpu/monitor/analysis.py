@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import json
 import logging
-import threading
 import urllib.request
 import uuid
 from typing import Any
@@ -57,6 +56,14 @@ class LLMBackend:
         self, prompt: str, max_tokens: int = 512, temperature: float = 0.1
     ) -> str:
         raise NotImplementedError
+
+    def generate_stream(
+        self, prompt: str, max_tokens: int = 512, temperature: float = 0.1
+    ):
+        """Yield text chunks.  Backends without true streaming yield the
+        whole completion once (keeps the SSE route backend-agnostic)."""
+        yield self.generate(prompt, max_tokens=max_tokens,
+                            temperature=temperature)
 
 
 class TemplateBackend(LLMBackend):
@@ -92,18 +99,23 @@ class TemplateBackend(LLMBackend):
 class LocalEngineBackend(LLMBackend):
     """In-process TPU inference through the continuous-batching engine.
 
-    Thread-safe: the HTTP server handles requests on a thread pool, while
-    the engine's step loop is single-threaded — a lock serializes
-    generate() calls (concurrency happens *inside* a call via the engine's
-    batching; see server.py for the batched query path).
+    Thread-safe and genuinely concurrent: a background ``EngineService``
+    thread owns the engine's step loop, and each generate() call submits a
+    request and waits on its handle — so N concurrent HTTP requests share
+    prefill batches and decode steps instead of serializing.
     """
 
     name = "tpu-local"
 
+    # Generations that outlive this are failed (queue + decode worst case).
+    GENERATION_TIMEOUT_S = 600.0
+
     def __init__(self, engine, tokenizer) -> None:
+        from k8s_llm_monitor_tpu.serving.service import EngineService
+
         self.engine = engine
         self.tokenizer = tokenizer
-        self._lock = threading.Lock()
+        self.service = EngineService(engine)
 
     @classmethod
     def from_config(cls, tpu_cfg) -> "LocalEngineBackend":
@@ -147,42 +159,56 @@ class LocalEngineBackend(LLMBackend):
     ) -> str:
         from k8s_llm_monitor_tpu.serving.engine import SamplingParams
 
-        with self._lock:
-            return self.engine.generate_text(
-                prompt,
-                SamplingParams(max_tokens=max_tokens, temperature=temperature),
-            )
-
-    def generate_batch(
-        self,
-        prompts: list[str],
-        max_tokens: int = 512,
-        temperature: float = 0.1,
-    ) -> list[str]:
-        """Continuous-batched generation for concurrent diagnosis queries."""
-        from k8s_llm_monitor_tpu.serving.engine import (
-            GenerationRequest,
-            SamplingParams,
+        handle = self.service.submit(
+            self.tokenizer.encode(prompt),
+            SamplingParams(max_tokens=max_tokens, temperature=temperature),
         )
+        res = handle.result(timeout=self.GENERATION_TIMEOUT_S)
+        if res.finish_reason == "error":
+            raise RuntimeError(f"generation failed: {res.error}")
+        return self.tokenizer.decode(res.token_ids)
 
-        with self._lock:
-            sampling = SamplingParams(max_tokens=max_tokens, temperature=temperature)
-            ids = [f"batch-{i}-{uuid.uuid4().hex[:6]}" for i in range(len(prompts))]
-            for rid, prompt in zip(ids, prompts):
-                self.engine.submit(
-                    GenerationRequest(
-                        request_id=rid,
-                        prompt_ids=self.tokenizer.encode(prompt),
-                        sampling=sampling,
-                    )
-                )
-            while self.engine.has_work:
-                self.engine.step()
-            out = []
-            for rid in ids:
-                res = self.engine.poll(rid)
-                out.append(self.tokenizer.decode(res.token_ids) if res else "")
-            return out
+    def generate_stream(
+        self, prompt: str, max_tokens: int = 512, temperature: float = 0.1
+    ):
+        """Yield decoded text increments as tokens come off the device.
+
+        Decodes cumulatively and emits suffixes so multi-byte/multi-token
+        graphemes never split mid-character.
+        """
+        from k8s_llm_monitor_tpu.serving.engine import SamplingParams
+
+        handle = self.service.submit(
+            self.tokenizer.encode(prompt),
+            SamplingParams(max_tokens=max_tokens, temperature=temperature),
+        )
+        toks: list[int] = []
+        emitted = ""
+        for tok in handle.stream(timeout=self.GENERATION_TIMEOUT_S):
+            toks.append(tok)
+            text = self.tokenizer.decode(toks)
+            # Hold back a trailing replacement char: it usually means a
+            # multi-byte grapheme is split mid-token and the next token will
+            # rewrite it.
+            stable = text[:-1] if text.endswith("�") else text
+            if len(stable) > len(emitted) and stable.startswith(emitted):
+                yield stable[len(emitted):]
+                emitted = stable
+        # Final flush: emit whatever the full decode has beyond (or instead
+        # of) what was streamed, so held-back or rewritten tails are never
+        # silently dropped.
+        if toks:
+            text = self.tokenizer.decode(toks)
+            if text != emitted:
+                common = 0
+                limit = min(len(text), len(emitted))
+                while common < limit and text[common] == emitted[common]:
+                    common += 1
+                if common < len(text):
+                    yield text[common:]
+        res = handle.result(timeout=1.0)
+        if res.finish_reason == "error":
+            raise RuntimeError(f"generation failed: {res.error}")
 
 
 class OpenAICompatBackend(LLMBackend):

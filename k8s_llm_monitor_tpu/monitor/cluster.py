@@ -112,8 +112,11 @@ class WatchStream:
 
     def __iter__(self) -> Iterator[tuple[str, dict[str, Any]]]:
         while True:
+            if self._closed.is_set() and self._q.empty():
+                return  # closed and drained (incl. re-iteration after close)
             item = self._q.get()
             if item is self._CLOSE:
+                self._q.put(self._CLOSE)  # keep the sentinel for other readers
                 return
             yield item
 

@@ -11,7 +11,7 @@ Layout conventions (chosen for TPU):
 
 The pure-XLA paged path here is the reference implementation and the CPU/test
 fallback; the Pallas TPU kernel lives in ops/pallas_attention.py and is
-selected at runtime by serving/engine.py.
+selected by ``select_attn_impl`` (used by serving/engine.py).
 """
 
 from __future__ import annotations
@@ -143,3 +143,24 @@ def paged_decode_attention(
     k = gather_pages(k_pages, block_table)
     v = gather_pages(v_pages, block_table)
     return decode_attention(q, k, v, lengths)
+
+
+def select_attn_impl(platform: str | None = None):
+    """Pick the paged-decode attention implementation for the backend.
+
+    TPU gets the Pallas kernel (block-table-driven HBM->VMEM streaming,
+    ops/pallas_attention.py); everything else (CPU tests, the virtual-device
+    dryrun) gets the XLA gather fallback above.
+    """
+    if platform is None:
+        platform = jax.default_backend()
+    if platform == "tpu":
+        try:
+            from k8s_llm_monitor_tpu.ops.pallas_attention import (
+                paged_decode_attention_pallas,
+            )
+
+            return paged_decode_attention_pallas
+        except Exception:  # pragma: no cover - import/lowering unavailable
+            return paged_decode_attention
+    return paged_decode_attention

@@ -1,0 +1,172 @@
+"""Pallas TPU kernel: paged decode attention over a block KV cache.
+
+The XLA fallback (ops/attention.py:paged_decode_attention) materializes every
+sequence's pages into a contiguous ``[B, max_blocks*bs, KVH, D]`` gather per
+layer per step — O(B * max_ctx) HBM traffic regardless of actual context
+lengths.  This kernel instead streams exactly the pages named in the block
+table through VMEM with online (flash-style) softmax accumulation:
+
+  * grid = (batch, max_blocks_per_seq); the block-table entry for grid cell
+    (b, j) drives the k/v page BlockSpec index map (scalar-prefetched, so the
+    DMA for page j+1 is issued while page j computes — Pallas double-buffers
+    revisited specs automatically).
+  * pages past a sequence's length map to the null block 0 and are skipped
+    with ``pl.when`` (consecutive identical indices skip the re-copy).
+  * GQA: each kv head's page slice serves its ``H // KVH`` query heads; the
+    online-softmax state (m, l, acc) lives in VMEM scratch across grid steps.
+
+Selected by ops/attention.py:select_attn_impl on TPU (single-chip engine);
+CPU tests run it in interpreter mode for parity with the XLA reference.
+Capability context: the reference has no kernels of any kind (pure Go control
+plane); this is part of the new TPU serving obligation (SURVEY.md §7 hard
+part #1).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def _decode_kernel(
+    # scalar prefetch
+    tables_ref,            # [B, NB] int32 block ids
+    lens_ref,              # [B] int32 valid kv length per sequence
+    # blocks
+    q_ref,                 # [1, H, D]
+    k_ref,                 # [1, bs, KVH, D] — page tables_ref[b, j]
+    v_ref,                 # [1, bs, KVH, D]
+    # out
+    o_ref,                 # [1, H, D]
+    # scratch (persists across the j grid dimension)
+    m_ref,                 # [H, 128] f32 running max
+    l_ref,                 # [H, 128] f32 running denominator
+    acc_ref,               # [H, D] f32 running numerator
+    *,
+    kv_heads: int,
+    q_per_kv: int,
+):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    bs = k_ref.shape[1]
+    D = q_ref.shape[2]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+
+    length = lens_ref[b]
+    start = j * bs
+
+    @pl.when(start < length)
+    def _block():
+        scale = D ** -0.5
+        # Positions covered by this page, masked against the true length.
+        pos = start + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+        valid = pos < length                                   # [1, bs]
+        for h in range(kv_heads):
+            sl = slice(h * q_per_kv, (h + 1) * q_per_kv)
+            qh = q_ref[0, sl, :].astype(jnp.float32) * scale   # [qpk, D]
+            kh = k_ref[0, :, h, :].astype(jnp.float32)         # [bs, D]
+            s = jax.lax.dot_general(
+                qh, kh, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                                   # [qpk, bs]
+            s = jnp.where(valid, s, NEG_INF)
+
+            m_prev = m_ref[sl, :]                               # [qpk, 128]
+            l_prev = l_ref[sl, :]
+            m_cur = jnp.max(s, axis=-1, keepdims=True)          # [qpk, 1]
+            m_new = jnp.maximum(m_prev, m_cur)                  # [qpk, 128]
+            alpha = jnp.exp(m_prev - m_new)
+            p = jnp.exp(s - m_new[:, :1])                       # [qpk, bs]
+            l_ref[sl, :] = alpha * l_prev + jnp.sum(
+                p, axis=-1, keepdims=True)
+            m_ref[sl, :] = m_new
+
+            vh = v_ref[0, :, h, :].astype(jnp.float32)          # [bs, D]
+            pv = jax.lax.dot_general(
+                p, vh, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )                                                   # [qpk, D]
+            acc_ref[sl, :] = alpha[:, :D] * acc_ref[sl, :] + pv
+
+    @pl.when(j == pl.num_programs(1) - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[:] / l_ref[:, :D]).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_decode_attention_pallas(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    block_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Single-token paged decode attention (drop-in for the XLA fallback).
+
+    Args:
+      q: [B, 1, H, D].
+      k_pages, v_pages: [num_blocks, bs, KVH, D].
+      block_table: [B, max_blocks_per_seq] int32 (entries past the sequence's
+        pages must be 0, the null block — serving/kv_cache.py guarantees it).
+      lengths: [B] int32 valid kv length (>= 1 for active lanes; the new
+        token's K/V must already be written at index lengths-1).
+      interpret: run in the Pallas interpreter (CPU parity tests).
+
+    Returns:
+      [B, 1, H, D] in q.dtype.
+    """
+    B, S, H, D = q.shape
+    assert S == 1, f"decode kernel expects one query token, got {S}"
+    _, bs, KVH, Dk = k_pages.shape
+    assert D == Dk and D <= 128, (D, Dk)
+    NB = block_table.shape[1]
+    q_per_kv = H // KVH
+
+    kernel = functools.partial(
+        _decode_kernel, kv_heads=KVH, q_per_kv=q_per_kv)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, NB),
+        in_specs=[
+            pl.BlockSpec((1, H, D), lambda b, j, tbl, lens: (b, 0, 0)),
+            pl.BlockSpec(
+                (1, bs, KVH, D),
+                lambda b, j, tbl, lens: (tbl[b, j], 0, 0, 0),
+            ),
+            pl.BlockSpec(
+                (1, bs, KVH, D),
+                lambda b, j, tbl, lens: (tbl[b, j], 0, 0, 0),
+            ),
+        ],
+        out_specs=pl.BlockSpec((1, H, D), lambda b, j, tbl, lens: (b, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, 128), jnp.float32),
+            pltpu.VMEM((H, D), jnp.float32),
+        ],
+    )
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary"),
+        ),
+        interpret=interpret,
+    )(block_table, lengths, q[:, 0], k_pages, v_pages)
+    return out[:, None]

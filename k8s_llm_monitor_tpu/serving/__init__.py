@@ -1,4 +1,5 @@
-"""Inference serving: paged KV allocator + continuous-batching engine."""
+"""Inference serving: paged KV allocator, continuous-batching engine, and
+the concurrent service front-end."""
 
 from k8s_llm_monitor_tpu.serving.kv_cache import BlockAllocator
 from k8s_llm_monitor_tpu.serving.engine import (
@@ -8,12 +9,15 @@ from k8s_llm_monitor_tpu.serving.engine import (
     InferenceEngine,
     SamplingParams,
 )
+from k8s_llm_monitor_tpu.serving.service import EngineService, RequestHandle
 
 __all__ = [
     "BlockAllocator",
     "EngineConfig",
+    "EngineService",
     "GenerationRequest",
     "GenerationResult",
     "InferenceEngine",
+    "RequestHandle",
     "SamplingParams",
 ]

@@ -3,18 +3,45 @@
 The decode loop is slot-based: a fixed-width batch of ``max_slots`` lanes is
 compiled exactly once (static shapes), and requests are admitted into / retired
 from lanes between steps.  Inactive lanes run with context_len=0 and the null
-KV block, so the compiled program never changes shape.  Prompts are prefilled
-one at a time into length buckets (powers of two), bounding both compile-cache
-size and decode-step starvation.
+KV block, so the compiled program never changes shape.
 
-Preemption: if the allocator runs out of pages mid-decode, the youngest slot
-is evicted and re-queued with its generated tokens folded into the prompt
-(recompute-style preemption), so long-running requests always make progress.
+Throughput design (the north-star SLO is p50 TTFT < 500 ms at 100 concurrent
+diagnosis queries, BASELINE.md):
+
+  * **Batched prefill** — up to ``max_prefills_per_step`` pending prompts are
+    ingested in ONE ``[P, bucket]`` prefill call (padded lanes are inactive),
+    and their first tokens are sampled inside the same compiled program, so an
+    admission round costs one dispatch regardless of how many it admits.
+  * **Fused multi-step decode** — ``decode_steps_per_iter`` decode steps run
+    inside one compiled ``lax.scan`` with on-device token feedback; per-lane
+    EOS detection and budget exhaustion are masked on device, so the host
+    syncs once per K steps instead of once per token.
+  * **Asynchronous reconciliation** — sampled tokens live in a device-resident
+    ``[max_slots]`` buffer that feeds the next decode call directly, so the
+    host never blocks on token values to keep the device busy.  Dispatched
+    calls join an in-flight queue (depth ``max_inflight``); their results are
+    fetched via ``copy_to_host_async`` and reconciled (emission, EOS/budget
+    retirement, TTFT stamping) behind the dispatch front.  This hides the
+    device->host latency that would otherwise serialize every step — on a
+    remote-tunneled chip that latency is the dominant cost, and on a local
+    chip it still buys dispatch/compute overlap.
+  * Prompts longer than the largest bucket go through chunked prefill
+    (continuation chunks attend to the paged prefix).
+
+Speculation note: EOS is only learned at reconcile time, so up to
+``max_inflight`` decode calls may keep stepping a finished lane.  Those
+zombie steps are confined to the lane's own pre-extended pages and their
+outputs are discarded at reconcile; pages of a retired lane are returned to
+the pool only after the last in-flight call that references them completes.
+
+Preemption: if the allocator runs out of pages, in-flight work is drained and
+the youngest slot is evicted and re-queued with its generated tokens folded
+into the prompt (recompute-style preemption), so long-running requests always
+make progress.
 
 This engine is the TPU replacement for the reference's never-implemented LLM
 path (its entire integration is config keys, reference
-internal/config/config.go:141-145); the north-star SLO it serves is 100
-concurrent diagnosis queries at p50 TTFT < 500 ms on v5e-8 (BASELINE.md).
+internal/config/config.go:141-145).
 """
 
 from __future__ import annotations
@@ -22,7 +49,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import time
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -66,33 +93,77 @@ class GenerationResult:
 
 @dataclasses.dataclass
 class EngineConfig:
-    max_slots: int = 8
+    max_slots: int = 16
     num_blocks: int = 512
     block_size: int = 16
     max_blocks_per_seq: int = 64
     prefill_buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024, 2048)
-    max_prefills_per_step: int = 1
+    # Requests ingested per batched-prefill call (the prefill lane count).
+    max_prefills_per_step: int = 8
+    # Batched-prefill admission rounds per scheduler step: a burst drains
+    # into slots at up to rounds*lanes requests before each decode run,
+    # which is TTFT-optimal for bursts while the cap bounds decode stall.
+    max_admission_rounds: int = 4
+    # Decode steps fused into one device call between host syncs.
+    decode_steps_per_iter: int = 8
+    # Dispatch-ahead depth: calls in flight before reconciling the oldest.
+    max_inflight: int = 2
 
 
 class _Slot:
-    __slots__ = ("req", "blocks", "ctx_len", "pending_token", "generated",
-                 "first_token_time")
+    __slots__ = ("req", "blocks", "ctx_len", "generated", "pending_admit",
+                 "inflight_decode", "first_token_time", "retired")
 
     def __init__(self, req: GenerationRequest, blocks: list[int]):
         self.req = req
         self.blocks = blocks
-        self.ctx_len = 0
-        self.pending_token = 0
-        self.generated: list[int] = []
+        self.ctx_len = 0          # reconciled tokens in the KV cache
+        self.generated: list[int] = []   # reconciled sampled tokens
+        self.pending_admit = True        # first token not yet reconciled
+        self.inflight_decode = 0         # decode steps dispatched, unreconciled
         self.first_token_time = 0.0
+        self.retired = False
+
+    # -- predicted (dispatch-side) state --------------------------------
+
+    @property
+    def gen_pred(self) -> int:
+        return (len(self.generated) + self.inflight_decode
+                + (1 if self.pending_admit else 0))
+
+    @property
+    def ctx_pred(self) -> int:
+        return self.ctx_len + self.inflight_decode
+
+    @property
+    def remaining_pred(self) -> int:
+        return self.req.sampling.max_tokens - self.gen_pred
+
+
+@dataclasses.dataclass
+class _Inflight:
+    kind: str                     # "admit" | "decode"
+    call_id: int
+    arr: Any                      # device array (async copy started)
+    # admit: [(slot_idx, req)]; decode: [(slot_idx, steps_i)]
+    lanes: list[tuple]
+
+
+# Sink signature: (request_id, new_token_ids, result_or_none).  ``result`` is
+# set exactly once per request, when it completes (or errors); new tokens are
+# delivered as they are reconciled, including the EOS token.
+TokenSink = Callable[[str, list[int], Optional[GenerationResult]], None]
 
 
 class InferenceEngine:
-    """Single-process engine over one jitted prefill + one jitted decode step.
+    """Single-process engine over jitted batched-prefill + fused-decode steps.
 
     When ``mesh`` is given, params and KV pages are GSPMD-sharded (TP over the
     ``model`` axis) and the same jitted functions run multi-chip — XLA inserts
     the collectives from the sharding annotations.
+
+    Not thread-safe: one thread owns the engine (see serving/service.py for
+    the concurrent front-end).
     """
 
     def __init__(
@@ -113,6 +184,7 @@ class InferenceEngine:
             tokenizer.eos_id if tokenizer is not None else -1
         )
         self.mesh = mesh
+        self.token_sink: Optional[TokenSink] = None
 
         ec = self.ecfg
         pages = llama.init_kv_pages(cfg, ec.num_blocks, ec.block_size)
@@ -140,48 +212,65 @@ class InferenceEngine:
         self.allocator = BlockAllocator(ec.num_blocks, ec.block_size)
 
         if attn_impl is None:
-            from k8s_llm_monitor_tpu.ops.attention import paged_decode_attention
-            attn_impl = paged_decode_attention
+            from k8s_llm_monitor_tpu.ops.attention import select_attn_impl
+            # The Pallas kernel is single-device; under a GSPMD mesh the
+            # XLA gather path partitions automatically, so keep it there.
+            attn_impl = select_attn_impl(
+                "cpu" if mesh is not None else None)
+        self._attn_impl = attn_impl
 
-        def _prefill_fn(params, tokens, lengths, pages, tables):
-            return llama.prefill(params, cfg, tokens, lengths, pages, tables)
+        def _prefill_sample_fn(params, tokens, lengths, pages, tables,
+                               temp, topk, topp, rng):
+            logits, pages = llama.prefill(
+                params, cfg, tokens, lengths, pages, tables
+            )
+            first = sample_tokens(
+                rng, logits, temperature=temp, top_k=topk, top_p=topp
+            )
+            return first, pages
+
+        def _prefill_greedy_fn(params, tokens, lengths, pages, tables):
+            # Sort-free fast path for all-greedy admission rounds: skips the
+            # [P, V] argsort nucleus filtering needs (V is 128k on the 8B
+            # target — the sort costs more than the unembed).
+            logits, pages = llama.prefill(
+                params, cfg, tokens, lengths, pages, tables
+            )
+            return greedy_tokens(logits), pages
 
         def _prefill_chunk_fn(params, tokens, start, lengths, pages, tables):
             return llama.prefill_chunk(
                 params, cfg, tokens, start, lengths, pages, tables
             )
 
-        def _decode_fn(params, tokens, ctx, pages, tables, temp, topk, topp, rng):
-            logits, pages = llama.decode_step(
-                params, cfg, tokens, ctx, pages, tables, attn_impl=attn_impl
-            )
-            nxt = sample_tokens(rng, logits, temperature=temp, top_k=topk, top_p=topp)
-            return nxt, pages
-
-        def _decode_greedy_fn(params, tokens, ctx, pages, tables):
-            # Sort-free fast path for all-greedy steps (the common diagnosis
-            # workload: temperature 0) — skips the [B, V] argsort + rank
-            # scatter sample_tokens needs for nucleus filtering.
-            logits, pages = llama.decode_step(
-                params, cfg, tokens, ctx, pages, tables, attn_impl=attn_impl
-            )
-            return greedy_tokens(logits), pages
+        def _place_fn(tok_state, first, idx):
+            # Scatter freshly sampled first tokens into the device-resident
+            # token buffer; padding lanes carry idx == max_slots and drop.
+            return tok_state.at[idx].set(first, mode="drop")
 
         # pages are donated so the scatter-updates happen in place on device.
-        self._prefill = jax.jit(_prefill_fn, donate_argnums=(3,))
+        self._prefill_sample = jax.jit(_prefill_sample_fn, donate_argnums=(3,))
+        self._prefill_greedy = jax.jit(_prefill_greedy_fn, donate_argnums=(3,))
         self._prefill_chunk = jax.jit(_prefill_chunk_fn, donate_argnums=(4,))
-        self._decode = jax.jit(_decode_fn, donate_argnums=(3,))
-        self._decode_greedy = jax.jit(_decode_greedy_fn, donate_argnums=(3,))
+        self._place_tokens = jax.jit(_place_fn, donate_argnums=(0,))
         self._sample = jax.jit(
             lambda rng, logits, t, k, p: sample_tokens(
                 rng, logits, temperature=t, top_k=k, top_p=p
             )
         )
+        # Fused-decode programs, built lazily per (n_steps, sampled).
+        self._decode_cache: dict[tuple[int, bool], Any] = {}
 
         self._rng = jax.random.PRNGKey(seed)
+        self._tok_state = jnp.zeros((ec.max_slots,), jnp.int32)
         self._pending: collections.deque[GenerationRequest] = collections.deque()
         self._slots: list[Optional[_Slot]] = [None] * ec.max_slots
         self._results: dict[str, GenerationResult] = {}
+        self._inflight: collections.deque[_Inflight] = collections.deque()
+        self._next_call_id = 0
+        # Blocks of retired slots still referenced by in-flight calls:
+        # released once the tagged call reconciles.
+        self._deferred_frees: list[tuple[int, list[int]]] = []
         self.steps = 0
         self.prefills = 0
         self.preemptions = 0
@@ -197,10 +286,10 @@ class InferenceEngine:
         return min(ec.max_blocks_per_seq, ec.num_blocks - 1) * ec.block_size
 
     def _cap_request(self, req: GenerationRequest) -> None:
-        """Enforce prompt_len + max_tokens <= capacity (reference ADVICE:
-        submit-time truncation prevents the block-table overflow crash and
-        the can_alloc livelock).  Keeps the prompt *tail* — diagnosis prompts
-        front-load boilerplate — and never produces a degenerate slice."""
+        """Enforce prompt_len + max_tokens <= capacity (submit-time truncation
+        prevents the block-table overflow crash and the can_alloc livelock).
+        Keeps the prompt *tail* — diagnosis prompts front-load boilerplate —
+        and never produces a degenerate slice."""
         cap = self.capacity_tokens
         sp = req.sampling
         if sp.max_tokens >= cap:
@@ -236,7 +325,16 @@ class InferenceEngine:
 
     @property
     def has_work(self) -> bool:
-        return bool(self._pending) or any(s is not None for s in self._slots)
+        return (bool(self._pending) or bool(self._inflight)
+                or any(s is not None for s in self._slots))
+
+    @property
+    def queue_depth(self) -> int:
+        return len(self._pending)
+
+    @property
+    def active_slots(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
 
     def generate(self, prompts: list[list[int]],
                  sampling: SamplingParams | None = None) -> list[GenerationResult]:
@@ -260,14 +358,28 @@ class InferenceEngine:
     # ------------------------------------------------------------------
 
     def step(self) -> None:
-        """One scheduler iteration: admit up to N prefills, then one decode."""
-        admitted = 0
-        while (admitted < self.ecfg.max_prefills_per_step
-               and self._pending and self._try_admit()):
-            admitted += 1
-        if any(s is not None for s in self._slots):
-            self._decode_once()
-        self.steps += 1
+        """One scheduler iteration: dispatch up to ``max_admission_rounds``
+        batched prefills and one fused decode, then reconcile in-flight
+        results down to the dispatch-ahead window (or fully, when there is
+        nothing left to dispatch)."""
+        dispatched = 0
+        rounds = 0
+        while rounds < self.ecfg.max_admission_rounds and self._admit_round():
+            rounds += 1
+            dispatched += 1
+        if self._dispatch_decode():
+            dispatched += 1
+        if dispatched:
+            while len(self._inflight) > self.ecfg.max_inflight:
+                self._reconcile_one()
+        else:
+            # Nothing dispatchable: drain so retirements/admissions unblock.
+            if self._inflight:
+                self._reconcile_one()
+
+    def _reconcile_all(self) -> None:
+        while self._inflight:
+            self._reconcile_one()
 
     # -- admission ------------------------------------------------------
 
@@ -275,7 +387,7 @@ class InferenceEngine:
         """Smallest prefill bucket covering ``n`` tokens.
 
         ``n`` must not exceed the largest bucket — longer prompts go through
-        chunked prefill (``_try_admit`` splits them), never silent clamping.
+        chunked prefill, never silent clamping.
         """
         for b in self.ecfg.prefill_buckets:
             if n <= b:
@@ -285,15 +397,12 @@ class InferenceEngine:
             f"{self.ecfg.prefill_buckets[-1]} — chunk before bucketing"
         )
 
-    def _free_slot(self) -> Optional[int]:
-        for i, s in enumerate(self._slots):
-            if s is None:
-                return i
-        return None
+    def _free_slots(self) -> list[int]:
+        return [i for i, s in enumerate(self._slots) if s is None]
 
     def _fail_request(self, req: GenerationRequest, msg: str) -> None:
         now = time.monotonic()
-        self._results[req.request_id] = GenerationResult(
+        result = GenerationResult(
             request_id=req.request_id,
             token_ids=req.prompt_ids[req.orig_prompt_len:]
             if req.orig_prompt_len >= 0 else [],
@@ -302,44 +411,116 @@ class InferenceEngine:
             latency_s=now - req.submit_time,
             error=msg,
         )
+        self._results[req.request_id] = result
+        if self.token_sink is not None:
+            self.token_sink(req.request_id, [], result)
 
-    def _try_admit(self) -> bool:
-        slot_idx = self._free_slot()
-        if slot_idx is None:
-            return False
-        req = self._pending[0]
-        L = len(req.prompt_ids)
-        if L + 1 > self.capacity_tokens:
-            # Defensive: submit() caps requests, so this only catches internal
-            # misuse; fail loudly instead of livelocking in can_alloc forever.
+    def _emit(self, req: GenerationRequest, toks: list[int]) -> None:
+        if self.token_sink is not None and toks:
+            self.token_sink(req.request_id, toks, None)
+
+    def _admit_round(self) -> bool:
+        """Dispatch one batched prefill+sample call for up to
+        ``max_prefills_per_step`` pending prompts.  Returns True if anything
+        was dispatched."""
+        ec = self.ecfg
+        top = ec.prefill_buckets[-1]
+        free = self._free_slots()
+        batch: list[tuple[int, GenerationRequest, list[int]]] = []
+        while len(batch) < ec.max_prefills_per_step and self._pending and free:
+            req = self._pending[0]
+            L = len(req.prompt_ids)
+            if L + 1 > self.capacity_tokens:
+                # Defensive: submit() caps requests, so this only catches
+                # internal misuse; fail loudly instead of livelocking.
+                self._pending.popleft()
+                self._fail_request(
+                    req, f"prompt of {L} tokens exceeds capacity "
+                         f"{self.capacity_tokens}")
+                continue
+            if not self.allocator.can_alloc(L + 1):
+                break
+            if L > top:
+                # Long prompt: serial chunked admission, alone in its round
+                # (the chunk loop runs per-request; batching short prompts
+                # around it would hold their first tokens hostage).
+                if batch:
+                    break
+                self._pending.popleft()
+                self._admit_long(req, free[0])
+                return True
             self._pending.popleft()
-            self._fail_request(req, f"prompt of {L} tokens exceeds capacity "
-                                    f"{self.capacity_tokens}")
-            return True
-        if not self.allocator.can_alloc(L + 1):
+            batch.append((free.pop(0), req, self.allocator.alloc(L + 1)))
+        if not batch:
             return False
-        self._pending.popleft()
+
+        # Fixed lane counts (1 or the max) keep the compile cache small.
+        P = 1 if len(batch) == 1 else ec.max_prefills_per_step
+        bucket = self._bucket(max(len(r.prompt_ids) for _, r, _ in batch))
+        tokens = np.zeros((P, bucket), np.int32)
+        lengths = np.zeros((P,), np.int32)
+        tables = np.zeros((P, ec.max_blocks_per_seq), np.int32)
+        # Padding lanes scatter their (garbage) first token out of range.
+        idx = np.full((P,), ec.max_slots, np.int32)
+        temp = np.zeros((P,), np.float32)
+        topk = np.zeros((P,), np.int32)
+        topp = np.ones((P,), np.float32)
+        for j, (slot_idx, req, blocks) in enumerate(batch):
+            L = len(req.prompt_ids)
+            if req.orig_prompt_len < 0:
+                req.orig_prompt_len = L
+            tokens[j, :L] = req.prompt_ids
+            lengths[j] = L
+            tables[j, : len(blocks)] = blocks
+            idx[j] = slot_idx
+            sp = req.sampling
+            temp[j], topk[j], topp[j] = sp.temperature, sp.top_k, sp.top_p
+
+        if all(r.sampling.temperature <= 0.0 for _, r, _ in batch):
+            first, self.pages = self._prefill_greedy(
+                self.params, jnp.asarray(tokens), jnp.asarray(lengths),
+                self.pages, jnp.asarray(tables),
+            )
+        else:
+            self._rng, sub = jax.random.split(self._rng)
+            first, self.pages = self._prefill_sample(
+                self.params, jnp.asarray(tokens), jnp.asarray(lengths),
+                self.pages, jnp.asarray(tables), jnp.asarray(temp),
+                jnp.asarray(topk), jnp.asarray(topp), sub,
+            )
+        self._finish_admit_dispatch(first, batch, idx)
+        return True
+
+    def _admit_long(self, req: GenerationRequest, slot_idx: int) -> None:
+        """Chunked prefill for prompts longer than the largest bucket: the
+        first chunk runs the dense path, continuations attend to the paged
+        prefix (llama.prefill_chunk)."""
+        ec = self.ecfg
+        L = len(req.prompt_ids)
         if req.orig_prompt_len < 0:
             req.orig_prompt_len = L
         blocks = self.allocator.alloc(L + 1)
-
-        table = np.zeros((1, self.ecfg.max_blocks_per_seq), np.int32)
+        table = np.zeros((1, ec.max_blocks_per_seq), np.int32)
         table[0, : len(blocks)] = blocks
         table_j = jnp.asarray(table)
 
-        # Chunked prefill: prompts longer than the largest bucket are split;
-        # the first chunk runs the dense path, continuations attend to the
-        # paged prefix (llama.prefill_chunk).
-        top = self.ecfg.prefill_buckets[-1]
-        first = min(L, top)
-        bucket = self._bucket(first)
-        tokens = np.zeros((1, bucket), np.int32)
-        tokens[0, :first] = req.prompt_ids[:first]
-        logits, self.pages = self._prefill(
+        top = ec.prefill_buckets[-1]
+        sp = req.sampling
+        self._rng, sub = jax.random.split(self._rng)
+
+        # First chunk (dense path); its sampled token is discarded — only the
+        # final chunk's logits matter.
+        tokens = np.zeros((1, top), np.int32)
+        tokens[0, :top] = req.prompt_ids[:top]
+        _, self.pages = self._prefill_sample(
             self.params, jnp.asarray(tokens),
-            jnp.asarray([first], jnp.int32), self.pages, table_j,
+            jnp.asarray([top], jnp.int32), self.pages, table_j,
+            jnp.asarray([0.0], jnp.float32),
+            jnp.asarray([0], jnp.int32),
+            jnp.asarray([1.0], jnp.float32), sub,
         )
-        pos = first
+        pos = top
+        logits = None
         while pos < L:
             n = min(L - pos, top)
             bucket = self._bucket(n)
@@ -351,9 +532,6 @@ class InferenceEngine:
                 self.pages, table_j,
             )
             pos += n
-        self.prefills += 1
-
-        sp = req.sampling
         self._rng, sub = jax.random.split(self._rng)
         first = self._sample(
             sub, logits,
@@ -361,96 +539,255 @@ class InferenceEngine:
             jnp.asarray([sp.top_k], jnp.int32),
             jnp.asarray([sp.top_p], jnp.float32),
         )
-        first_id = int(np.asarray(first)[0])
+        self._finish_admit_dispatch(
+            first, [(slot_idx, req, blocks)],
+            np.asarray([slot_idx], np.int32))
 
-        slot = _Slot(req, blocks)
-        slot.ctx_len = L
-        slot.pending_token = first_id
-        slot.generated = [first_id]
-        if req.first_token_time == 0.0:
-            req.first_token_time = time.monotonic()
-        slot.first_token_time = req.first_token_time
-        self._slots[slot_idx] = slot
-        if self._is_finished(slot):
-            self._retire(slot_idx)
-        return True
+    def _finish_admit_dispatch(self, first, batch, idx) -> None:
+        """Shared tail of both admission paths: place first tokens into the
+        device token buffer, start the async host copy, occupy slots, and
+        queue the reconcile entry."""
+        self._tok_state = self._place_tokens(
+            self._tok_state, first, jnp.asarray(idx))
+        try:
+            first.copy_to_host_async()
+        except AttributeError:  # non-jax array (tests with stub impls)
+            pass
+        lanes = []
+        for slot_idx, req, blocks in batch:
+            slot = _Slot(req, blocks)
+            slot.ctx_len = len(req.prompt_ids)
+            self._slots[slot_idx] = slot
+            lanes.append((slot_idx, req))
+        self.prefills += len(batch)
+        self._inflight.append(_Inflight(
+            kind="admit", call_id=self._next_call_id, arr=first, lanes=lanes))
+        self._next_call_id += 1
 
     # -- decode ---------------------------------------------------------
 
-    def _decode_once(self) -> None:
+    def _decode_program(self, n_steps: int, sampled: bool):
+        """Build (and cache) the fused K-step decode program.
+
+        The scan carries (token, ctx, done, pages[, rng]) on device: each
+        iteration feeds the previous step's sampled token back in without a
+        host round-trip, EOS and per-lane budget exhaustion flip lanes to the
+        masked state (writes -> null block), and the emitted [K, B] token
+        matrix uses -1 for steps where a lane was not active.  Returns
+        (toks [K, B], final token state [B], pages).
+        """
+        key = (n_steps, sampled)
+        prog = self._decode_cache.get(key)
+        if prog is not None:
+            return prog
+
+        cfg = self.cfg
+        attn_impl = self._attn_impl
+
+        def _step_core(params, tokens, ctx, act, pages, tables):
+            ctx_eff = jnp.where(act, ctx, 0)
+            logits, pages = llama.decode_step(
+                params, cfg, tokens, ctx_eff, pages, tables,
+                attn_impl=attn_impl,
+            )
+            return logits, pages
+
+        if sampled:
+            def fn(params, tok_state, ctx, remaining, pages, tables,
+                   temp, topk, topp, rng, eos):
+                active0 = ctx > 0
+
+                def body(carry, i):
+                    tokens, ctx, done, rng, pages = carry
+                    act = active0 & ~done & (i < remaining)
+                    logits, pages = _step_core(
+                        params, tokens, ctx, act, pages, tables)
+                    rng, sub = jax.random.split(rng)
+                    nxt = sample_tokens(sub, logits, temperature=temp,
+                                        top_k=topk, top_p=topp)
+                    nxt = jnp.where(act, nxt, tokens)
+                    done = done | (act & (nxt == eos))
+                    ctx = jnp.where(act, ctx + 1, ctx)
+                    out = jnp.where(act, nxt, -1)
+                    return (nxt, ctx, done, rng, pages), out
+
+                done0 = jnp.zeros_like(active0)
+                (tok_state, _, _, _, pages), toks = jax.lax.scan(
+                    body, (tok_state, ctx, done0, rng, pages),
+                    jnp.arange(n_steps, dtype=jnp.int32))
+                return toks, tok_state, pages
+
+            prog = jax.jit(fn, donate_argnums=(1, 4))
+        else:
+            def fn(params, tok_state, ctx, remaining, pages, tables, eos):
+                active0 = ctx > 0
+
+                def body(carry, i):
+                    tokens, ctx, done, pages = carry
+                    act = active0 & ~done & (i < remaining)
+                    logits, pages = _step_core(
+                        params, tokens, ctx, act, pages, tables)
+                    nxt = greedy_tokens(logits)
+                    nxt = jnp.where(act, nxt, tokens)
+                    done = done | (act & (nxt == eos))
+                    ctx = jnp.where(act, ctx + 1, ctx)
+                    out = jnp.where(act, nxt, -1)
+                    return (nxt, ctx, done, pages), out
+
+                done0 = jnp.zeros_like(active0)
+                (tok_state, _, _, pages), toks = jax.lax.scan(
+                    body, (tok_state, ctx, done0, pages),
+                    jnp.arange(n_steps, dtype=jnp.int32))
+                return toks, tok_state, pages
+
+            prog = jax.jit(fn, donate_argnums=(1, 4))
+        self._decode_cache[key] = prog
+        return prog
+
+    def _dispatch_decode(self) -> bool:
+        """Dispatch one fused decode call over lanes with predicted budget.
+        Returns True if a call was dispatched."""
         ec = self.ecfg
         B = ec.max_slots
-        tokens = np.zeros((B,), np.int32)
-        ctx = np.zeros((B,), np.int32)
-        table = np.zeros((B, ec.max_blocks_per_seq), np.int32)
-        temp = np.zeros((B,), np.float32)
-        topk = np.zeros((B,), np.int32)
-        topp = np.ones((B,), np.float32)
 
-        # Ensure every active slot has a page for the incoming token.  On
-        # pressure, evict the *youngest* active slot (recompute-preemption)
-        # so the oldest requests always make progress — guarantees the loop
-        # drains even when the pool is smaller than the working set.  The
-        # youngest slot may be the one that failed, in which case it evicts
-        # itself rather than stealing pages from an older request.
+        lanes = [(i, s) for i, s in enumerate(self._slots)
+                 if s is not None and s.remaining_pred > 0]
+        if not lanes:
+            return False
+
+        kmax = min(ec.decode_steps_per_iter,
+                   max(s.remaining_pred for _, s in lanes))
+        K = 1 << (kmax.bit_length() - 1)
+
+        # Ensure pages for each lane's next min(K, remaining) KV writes.  On
+        # pressure, drain speculation (so preemption sees reconciled state)
+        # and evict the *youngest* active slot so the oldest always makes
+        # progress; the youngest may be the failing one, evicting itself.
         def _youngest_active() -> int:
             return max(
                 (j for j, sl in enumerate(self._slots) if sl is not None),
                 key=lambda j: self._slots[j].req.submit_time,
             )
 
-        for i in sorted(
-            (i for i, s in enumerate(self._slots) if s is not None),
-            key=lambda i: self._slots[i].req.submit_time,
-        ):
-            s = self._slots[i]
-            if s is None:  # already evicted below
-                continue
+        for i, s in sorted(lanes, key=lambda t: t[1].req.submit_time):
+            if self._slots[i] is not s or s.retired:
+                continue  # evicted/retired during the pressure loop below
+            steps_i = max(1, min(K, s.remaining_pred))
             while True:
                 try:
-                    self.allocator.extend(s.blocks, s.ctx_len + 1)
+                    self.allocator.extend(s.blocks, s.ctx_pred + steps_i)
                     break
                 except OutOfBlocks:
-                    victim = _youngest_active()
-                    self._preempt(victim)
-                    if victim == i:
+                    self._reconcile_all()
+                    if self._slots[i] is not s or s.retired:
                         break
+                    try:
+                        self.allocator.extend(s.blocks, s.ctx_pred + steps_i)
+                        break
+                    except OutOfBlocks:
+                        victim = _youngest_active()
+                        self._preempt(victim)
+                        if victim == i:
+                            break
 
-        active = [(i, s) for i, s in enumerate(self._slots) if s is not None]
-        if not active:
-            return
-        for i, s in active:
-            tokens[i] = s.pending_token
-            ctx[i] = s.ctx_len
+        lanes = [(i, s) for i, s in enumerate(self._slots)
+                 if s is not None and not s.retired and s.remaining_pred > 0]
+        if not lanes:
+            return False
+
+        ctx = np.zeros((B,), np.int32)
+        steps_arr = np.zeros((B,), np.int32)
+        table = np.zeros((B, ec.max_blocks_per_seq), np.int32)
+        temp = np.zeros((B,), np.float32)
+        topk = np.zeros((B,), np.int32)
+        topp = np.ones((B,), np.float32)
+        meta = []
+        for i, s in lanes:
+            steps_i = min(K, s.remaining_pred)
+            ctx[i] = s.ctx_pred
+            steps_arr[i] = steps_i
             table[i, : len(s.blocks)] = s.blocks
             sp = s.req.sampling
             temp[i], topk[i], topp[i] = sp.temperature, sp.top_k, sp.top_p
+            s.inflight_decode += steps_i
+            # Keep the slot object: by reconcile time the index may host a
+            # different request (zombie lane whose slot was reused).
+            meta.append((i, s, steps_i))
 
-        if all(s.req.sampling.temperature <= 0.0 for _, s in active):
-            nxt, self.pages = self._decode_greedy(
-                self.params, jnp.asarray(tokens), jnp.asarray(ctx),
-                self.pages, jnp.asarray(table),
+        eos = jnp.asarray(self.eos_id, jnp.int32)
+        all_greedy = all(s.req.sampling.temperature <= 0.0 for _, s in lanes)
+        if all_greedy:
+            prog = self._decode_program(K, sampled=False)
+            toks, self._tok_state, self.pages = prog(
+                self.params, self._tok_state, jnp.asarray(ctx),
+                jnp.asarray(steps_arr), self.pages, jnp.asarray(table), eos,
             )
         else:
+            prog = self._decode_program(K, sampled=True)
             self._rng, sub = jax.random.split(self._rng)
-            nxt, self.pages = self._decode(
-                self.params, jnp.asarray(tokens), jnp.asarray(ctx), self.pages,
-                jnp.asarray(table), jnp.asarray(temp), jnp.asarray(topk),
-                jnp.asarray(topp), sub,
+            toks, self._tok_state, self.pages = prog(
+                self.params, self._tok_state, jnp.asarray(ctx),
+                jnp.asarray(steps_arr), self.pages, jnp.asarray(table),
+                jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
+                sub, eos,
             )
-        nxt = np.asarray(nxt)
+        try:
+            toks.copy_to_host_async()
+        except AttributeError:
+            pass
+        self.steps += K
+        self._inflight.append(_Inflight(
+            kind="decode", call_id=self._next_call_id, arr=toks, lanes=meta))
+        self._next_call_id += 1
+        return True
 
-        for i, s in active:
-            s.ctx_len += 1          # pending token's KV is now in cache
-            tok = int(nxt[i])
-            s.pending_token = tok
-            s.generated.append(tok)
-            if self._is_finished(s):
-                self._retire(i)
+    # -- reconciliation -------------------------------------------------
+
+    def _reconcile_one(self) -> None:
+        call = self._inflight.popleft()
+        arr = np.asarray(call.arr)
+        if call.kind == "admit":
+            now = time.monotonic()
+            for j, (slot_idx, req) in enumerate(call.lanes):
+                s = self._slots[slot_idx]
+                if s is None or s.req is not req:
+                    continue  # preempted before reconcile
+                tok = int(arr[j])
+                s.pending_admit = False
+                s.generated.append(tok)
+                if req.first_token_time == 0.0:
+                    req.first_token_time = now
+                s.first_token_time = req.first_token_time
+                self._emit(req, [tok])
+                if self._is_finished(s):
+                    self._retire(slot_idx)
+        else:
+            for slot_idx, s, steps_i in call.lanes:
+                if self._slots[slot_idx] is not s or s.retired:
+                    continue  # lane EOSed in an earlier call; discard zombies
+                new = [int(t) for t in arr[:, slot_idx] if t >= 0]
+                s.inflight_decode -= steps_i
+                if not new:
+                    continue
+                s.ctx_len += len(new)
+                s.generated.extend(new)
+                self._emit(s.req, new)
+                if self._is_finished(s):
+                    self._retire(slot_idx)
+        # Release deferred frees that no in-flight call references anymore.
+        if self._deferred_frees:
+            still = []
+            for after_id, blocks in self._deferred_frees:
+                if after_id <= call.call_id:
+                    self.allocator.free(blocks)
+                else:
+                    still.append((after_id, blocks))
+            self._deferred_frees = still
 
     def _is_finished(self, s: _Slot) -> bool:
-        return (s.generated[-1] == self.eos_id
-                or len(s.generated) >= s.req.sampling.max_tokens)
+        return bool(s.generated) and (
+            s.generated[-1] == self.eos_id
+            or len(s.generated) >= s.req.sampling.max_tokens)
 
     def _retire(self, slot_idx: int) -> None:
         s = self._slots[slot_idx]
@@ -461,22 +798,36 @@ class InferenceEngine:
         reason = "eos" if toks and toks[-1] == self.eos_id else "length"
         if reason == "eos":
             toks = toks[:-1]
-        self._results[s.req.request_id] = GenerationResult(
+        result = GenerationResult(
             request_id=s.req.request_id,
             token_ids=toks,
             finish_reason=reason,
             ttft_s=s.first_token_time - s.req.submit_time,
             latency_s=now - s.req.submit_time,
         )
-        self.allocator.free(s.blocks)
+        self._results[s.req.request_id] = result
+        if self.token_sink is not None:
+            self.token_sink(s.req.request_id, [], result)
+        if self._inflight:
+            # In-flight calls may still write into these pages (zombie
+            # steps); free only after the newest dispatched call reconciles.
+            self._deferred_frees.append(
+                (self._next_call_id - 1, s.blocks))
+        else:
+            self.allocator.free(s.blocks)
+        s.retired = True
         self._slots[slot_idx] = None
 
     def _preempt(self, slot_idx: int) -> None:
-        """Evict a slot, folding generated tokens into a new prompt."""
+        """Evict a slot, folding generated tokens into a new prompt.
+
+        Only called on reconciled state (_dispatch_decode drains in-flight
+        work before preempting), so ``generated`` is complete."""
         s = self._slots[slot_idx]
-        assert s is not None
+        assert s is not None and s.inflight_decode == 0
         self.allocator.free(s.blocks)
         self._slots[slot_idx] = None
+        s.retired = True
         req = s.req
         # Already-sampled tokens become prompt; budget shrinks accordingly.
         consumed = len(s.generated)

@@ -1,0 +1,201 @@
+"""Concurrent front-end for the inference engine.
+
+``InferenceEngine`` is single-threaded by design (one thread owns device
+state); ``EngineService`` wraps it in a background step-loop thread plus a
+thread-safe submit API, so N concurrent callers (e.g. the HTTP server's
+request threads) share prefill batches and decode steps instead of
+serializing whole generations.  This is the concurrency layer the north-star
+SLO needs: 100 concurrent diagnosis queries share the continuous batch
+(BASELINE.md config #4).
+
+Per-request ``RequestHandle``s deliver tokens as the engine fetches them from
+device (streaming seam for SSE in monitor/server.py) and a final
+``GenerationResult``.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+from typing import Iterator, Optional
+
+from k8s_llm_monitor_tpu.serving.engine import (
+    GenerationRequest,
+    GenerationResult,
+    InferenceEngine,
+    SamplingParams,
+)
+
+
+class RequestHandle:
+    """Ticket for one in-flight generation.
+
+    ``stream()`` yields token ids as they are generated (EOS excluded);
+    ``result()`` blocks for the final GenerationResult.  Both may be used on
+    the same handle from different threads.
+    """
+
+    def __init__(self, request_id: str, eos_id: int):
+        self.request_id = request_id
+        self._eos_id = eos_id
+        self._tokens: "queue.Queue[Optional[int]]" = queue.Queue()
+        self._done = threading.Event()
+        self._result: Optional[GenerationResult] = None
+
+    # -- engine side ----------------------------------------------------
+
+    def _push(self, toks: list[int], result: Optional[GenerationResult]) -> None:
+        for t in toks:
+            if t != self._eos_id:
+                self._tokens.put(t)
+        if result is not None:
+            self._result = result
+            self._done.set()
+            self._tokens.put(None)  # stream sentinel
+
+    # -- caller side ----------------------------------------------------
+
+    def stream(self, timeout: Optional[float] = None) -> Iterator[int]:
+        """Yield generated token ids until completion (EOS not yielded).
+
+        ``timeout`` bounds the wait for each *next* token; on expiry a
+        TimeoutError is raised (matching ``result()``'s contract)."""
+        while True:
+            try:
+                tok = self._tokens.get(timeout=timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"generation {self.request_id}: no token within "
+                    f"{timeout}s") from None
+            if tok is None:
+                return
+            yield tok
+
+    def result(self, timeout: Optional[float] = None) -> GenerationResult:
+        if not self._done.wait(timeout=timeout):
+            raise TimeoutError(
+                f"generation {self.request_id} not done within {timeout}s")
+        assert self._result is not None
+        return self._result
+
+    @property
+    def done(self) -> bool:
+        return self._done.is_set()
+
+
+class EngineService:
+    """Background step-loop over an ``InferenceEngine`` with thread-safe
+    submission.  The loop thread is the only toucher of engine state; callers
+    talk through a submission queue and per-request handles."""
+
+    def __init__(self, engine: InferenceEngine):
+        self.engine = engine
+        engine.token_sink = self._sink
+        self._submissions: "queue.Queue[GenerationRequest]" = queue.Queue()
+        self._handles: dict[str, RequestHandle] = {}
+        self._handles_lock = threading.Lock()
+        self._ids = itertools.count()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._dead: str | None = None  # set when the step loop dies
+        self._thread = threading.Thread(
+            target=self._run, name="engine-service", daemon=True)
+        self._thread.start()
+
+    # -- submission -----------------------------------------------------
+
+    def submit(
+        self,
+        prompt_ids: list[int],
+        sampling: SamplingParams | None = None,
+        request_id: str | None = None,
+    ) -> RequestHandle:
+        if self._dead is not None:
+            raise RuntimeError(f"engine service is dead: {self._dead}")
+        if request_id is None:
+            request_id = f"svc-{next(self._ids)}"
+        handle = RequestHandle(request_id, self.engine.eos_id)
+        with self._handles_lock:
+            self._handles[request_id] = handle
+        self._submissions.put(GenerationRequest(
+            request_id=request_id,
+            prompt_ids=list(prompt_ids),
+            sampling=sampling or SamplingParams(),
+        ))
+        self._wake.set()
+        return handle
+
+    def submit_text(self, prompt: str,
+                    sampling: SamplingParams | None = None) -> RequestHandle:
+        tok = self.engine.tokenizer
+        assert tok is not None, "engine has no tokenizer"
+        return self.submit(tok.encode(prompt), sampling)
+
+    def generate_text(self, prompt: str,
+                      sampling: SamplingParams | None = None,
+                      timeout: Optional[float] = None) -> str:
+        """Submit and block for the decoded completion."""
+        res = self.submit_text(prompt, sampling).result(timeout=timeout)
+        if res.finish_reason == "error":
+            raise RuntimeError(f"generation failed: {res.error}")
+        tok = self.engine.tokenizer
+        return tok.decode(res.token_ids)
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        self._wake.set()
+        self._thread.join(timeout=timeout)
+
+    # -- loop -----------------------------------------------------------
+
+    def _drain_submissions(self) -> None:
+        while True:
+            try:
+                self.engine.submit(self._submissions.get_nowait())
+            except queue.Empty:
+                return
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            self._drain_submissions()
+            if self.engine.has_work:
+                try:
+                    self.engine.step()
+                except Exception as exc:  # engine is corrupt — fail everything
+                    self._dead = f"engine step failed: {exc!r}"
+                    self._fail_all(self._dead)
+                    raise
+            else:
+                # Idle: sleep until a submission arrives.
+                self._wake.wait(timeout=0.05)
+                self._wake.clear()
+
+    def _fail_all(self, msg: str) -> None:
+        # Drain submissions that raced the death of the loop so their
+        # handles fail instead of hanging until timeout.
+        while True:
+            try:
+                self._submissions.get_nowait()
+            except queue.Empty:
+                break
+        with self._handles_lock:
+            handles = list(self._handles.values())
+            self._handles.clear()
+        for h in handles:
+            h._push([], GenerationResult(
+                request_id=h.request_id, token_ids=[], finish_reason="error",
+                ttft_s=0.0, latency_s=0.0, error=msg,
+            ))
+
+    def _sink(self, request_id: str, toks: list[int],
+              result: Optional[GenerationResult]) -> None:
+        with self._handles_lock:
+            handle = self._handles.get(request_id)
+            if result is not None:
+                self._handles.pop(request_id, None)
+        if handle is not None:
+            handle._push(toks, result)
+        if result is not None:
+            # Results are delivered through handles; drop the engine's copy.
+            self.engine.poll(request_id)

@@ -1,0 +1,252 @@
+"""KubeRestBackend against a stub HTTP server speaking the Kubernetes wire
+format: core lists, logs, metrics.k8s.io, chunked-JSON watch streams, CR
+CRUD + /status, error mapping, kubeconfig parsing, and pods/exec over the
+WebSocket upgrade (v4.channel.k8s.io).
+"""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import pytest
+
+from k8s_llm_monitor_tpu.monitor.cluster import Conflict, NotFound
+from k8s_llm_monitor_tpu.monitor.kube_rest import (
+    KubeRestBackend,
+    ws_accept_key,
+    ws_encode_frame,
+)
+
+NODES = [{"metadata": {"name": "node-a"},
+          "status": {"capacity": {"cpu": "4", "memory": "8Gi"}}}]
+PODS = [{"metadata": {"name": "web", "namespace": "default"},
+         "status": {"phase": "Running"}}]
+
+
+class _Stub(BaseHTTPRequestHandler):
+    server_version = "StubK8s/1.0"
+    protocol_version = "HTTP/1.1"   # chunked watch responses need 1.1
+    crs: dict = {}          # (ns, name) -> body, shared per server instance
+    watch_events: list = []
+
+    def log_message(self, *a):  # silence
+        pass
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _watch(self):
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+        for evt in self.watch_events:
+            line = (json.dumps(evt) + "\n").encode()
+            self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+            self.wfile.flush()
+        self.wfile.write(b"0\r\n\r\n")
+
+    def _exec_ws(self):
+        key = self.headers["Sec-WebSocket-Key"]
+        self.send_response(101, "Switching Protocols")
+        self.send_header("Upgrade", "websocket")
+        self.send_header("Connection", "Upgrade")
+        self.send_header("Sec-WebSocket-Accept", ws_accept_key(key))
+        self.send_header("Sec-WebSocket-Protocol", "v4.channel.k8s.io")
+        self.end_headers()
+        q = parse_qs(urlparse(self.path).query)
+        out = f"ran: {' '.join(q['command'])}\n".encode()
+        conn = self.connection
+        conn.sendall(ws_encode_frame(0x2, b"\x01" + out, mask=False))
+        conn.sendall(ws_encode_frame(0x2, b"\x02" + b"warn\n", mask=False))
+        status = json.dumps({"status": "Failure", "details": {
+            "causes": [{"reason": "ExitCode", "message": "3"}]}}).encode()
+        conn.sendall(ws_encode_frame(0x2, b"\x03" + status, mask=False))
+        conn.sendall(ws_encode_frame(0x8, b"", mask=False))
+
+    def do_GET(self):  # noqa: N802
+        url = urlparse(self.path)
+        q = parse_qs(url.query)
+        path = url.path
+        if path.endswith("/exec") or "/pods/" in path and "exec" in q.get("", []):
+            pass
+        if path == "/version":
+            return self._json({"gitVersion": "v1.29.0-stub"})
+        if path == "/api/v1/nodes":
+            return self._json({"items": NODES})
+        if path == "/api/v1/namespaces/default/pods":
+            if q.get("watch"):
+                return self._watch()
+            return self._json({"items": PODS})
+        if path == "/api/v1/namespaces/default/events":
+            limit = int(q.get("limit", ["0"])[0])
+            items = [{"reason": f"r{i}"} for i in range(10)]
+            return self._json({"items": items[:limit] if limit else items})
+        if path == "/api/v1/namespaces/default/pods/web/log":
+            body = "line1\nline2\n".encode()
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return None
+        if path == "/apis/metrics.k8s.io/v1beta1/nodes":
+            return self._json({"items": [
+                {"metadata": {"name": "node-a"},
+                 "usage": {"cpu": "250m", "memory": "1Gi"}}]})
+        if path.startswith("/apis/monitoring.io/v1/"):
+            name = path.rsplit("/", 1)[-1]
+            if path.endswith("/uavmetrics"):
+                return self._json({"items": list(self.crs.values())})
+            if ("default", name) in self.crs:
+                return self._json(self.crs[("default", name)])
+            return self._json({"message": "not found"}, code=404)
+        return self._json({"message": f"no route {path}"}, code=404)
+
+    def do_POST(self):  # noqa: N802
+        path = urlparse(self.path).path
+        body = json.loads(self.rfile.read(
+            int(self.headers.get("Content-Length", 0))))
+        if path == "/apis/monitoring.io/v1/namespaces/default/uavmetrics":
+            name = body["metadata"]["name"]
+            if ("default", name) in self.crs:
+                return self._json({"message": "exists"}, code=409)
+            self.crs[("default", name)] = body
+            return self._json(body, code=201)
+        return self._json({"message": "bad route"}, code=404)
+
+    def do_PUT(self):  # noqa: N802
+        path = urlparse(self.path).path
+        body = json.loads(self.rfile.read(
+            int(self.headers.get("Content-Length", 0))))
+        parts = path.split("/")
+        if parts[-1] == "status":
+            name = parts[-2]
+            cur = self.crs.get(("default", name))
+            if cur is None:
+                return self._json({"message": "nf"}, code=404)
+            cur["status"] = body.get("status", {})
+            return self._json(cur)
+        name = parts[-1]
+        if ("default", name) not in self.crs:
+            return self._json({"message": "nf"}, code=404)
+        self.crs[("default", name)] = body
+        return self._json(body)
+
+
+class _ExecStub(_Stub):
+    def do_GET(self):  # noqa: N802
+        if urlparse(self.path).path.endswith("/exec"):
+            return self._exec_ws()
+        return super().do_GET()
+
+
+@pytest.fixture()
+def server():
+    handler = type("H", (_ExecStub,), {"crs": {}, "watch_events": [
+        {"type": "ADDED", "object": {"metadata": {"name": "web"}}},
+        {"type": "MODIFIED", "object": {"metadata": {"name": "web"}}},
+        {"type": "BOOKMARK", "object": {}},
+    ]})
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), handler)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+@pytest.fixture()
+def backend(server):
+    return KubeRestBackend(f"http://127.0.0.1:{server.server_address[1]}",
+                           token="tok-123", timeout=5.0, watch_timeout=5.0)
+
+
+def test_core_reads(backend):
+    assert backend.server_version() == "v1.29.0-stub"
+    assert backend.list_nodes()[0]["metadata"]["name"] == "node-a"
+    assert backend.list_pods("default")[0]["metadata"]["name"] == "web"
+    assert len(backend.list_events("default", limit=3)) == 3
+    assert backend.pod_logs("default", "web") == "line1\nline2\n"
+    usage = backend.node_usage()
+    assert usage[0]["usage"]["cpu"] == "250m"
+
+
+def test_watch_stream_and_close(backend):
+    stream = backend.watch("pods", "default")
+    events = list(stream)  # server closes after 3 events (BOOKMARK dropped)
+    assert [e[0] for e in events] == ["ADDED", "MODIFIED"]
+    assert stream.closed
+
+    stream2 = backend.watch("pods", "default")
+    stream2.close()  # client-side close must end iteration promptly
+    assert len(list(stream2)) <= 2
+
+
+def test_cr_crud_and_errors(backend):
+    g, v, p = "monitoring.io", "v1", "uavmetrics"
+    body = {"metadata": {"name": "uavmetric-node-a"},
+            "spec": {"battery": {"remaining_percent": 88}}}
+    created = backend.create_custom_resource(g, v, p, "default", body)
+    assert created["spec"]["battery"]["remaining_percent"] == 88
+
+    with pytest.raises(Conflict):
+        backend.create_custom_resource(g, v, p, "default", body)
+
+    got = backend.get_custom_resource(g, v, p, "default", "uavmetric-node-a")
+    assert got["metadata"]["name"] == "uavmetric-node-a"
+
+    with pytest.raises(NotFound):
+        backend.get_custom_resource(g, v, p, "default", "missing")
+
+    body["spec"]["battery"]["remaining_percent"] = 70
+    backend.update_custom_resource(g, v, p, "default", body)
+    assert backend.list_custom_resources(g, v, p, "default")[0][
+        "spec"]["battery"]["remaining_percent"] == 70
+
+    backend.update_custom_resource_status(
+        g, v, p, "default",
+        {"metadata": {"name": "uavmetric-node-a"},
+         "status": {"collection_status": "active"}})
+    got = backend.get_custom_resource(g, v, p, "default", "uavmetric-node-a")
+    assert got["status"]["collection_status"] == "active"
+
+
+def test_exec_websocket(backend):
+    out, err, code = backend.exec_in_pod(
+        "default", "web", ["ping", "-c", "3", "10.0.0.1"])
+    assert out == "ran: ping -c 3 10.0.0.1\n"
+    assert err == "warn\n"
+    assert code == 3
+
+
+def test_from_kubeconfig(tmp_path, server):
+    port = server.server_address[1]
+    cfg = {
+        "current-context": "stub",
+        "contexts": [{"name": "stub",
+                      "context": {"cluster": "c1", "user": "u1"}}],
+        "clusters": [{"name": "c1",
+                      "cluster": {"server": f"http://127.0.0.1:{port}"}}],
+        "users": [{"name": "u1", "user": {"token": "secret-token"}}],
+    }
+    import yaml as _yaml
+
+    path = tmp_path / "kubeconfig"
+    path.write_text(_yaml.safe_dump(cfg))
+    b = KubeRestBackend.from_kubeconfig(str(path))
+    assert b.token == "secret-token"
+    assert b.server_version() == "v1.29.0-stub"
+
+
+def test_missing_kubeconfig_raises(tmp_path):
+    from k8s_llm_monitor_tpu.monitor.cluster import ClusterError
+
+    with pytest.raises(ClusterError):
+        KubeRestBackend.from_kubeconfig(str(tmp_path / "nope"))

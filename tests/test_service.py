@@ -1,0 +1,121 @@
+"""EngineService: concurrent submissions share the engine's continuous batch;
+token sink emits incrementally; streaming handles deliver tokens.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from k8s_llm_monitor_tpu.models import llama
+from k8s_llm_monitor_tpu.models.config import ModelConfig
+from k8s_llm_monitor_tpu.serving.engine import (
+    EngineConfig,
+    GenerationRequest,
+    InferenceEngine,
+    SamplingParams,
+)
+from k8s_llm_monitor_tpu.serving.service import EngineService
+
+CFG = ModelConfig(name="t", vocab_size=300, hidden_size=32, intermediate_size=64,
+                  num_layers=2, num_heads=4, num_kv_heads=2, dtype="float32",
+                  rope_theta=10_000.0)
+
+ECFG = dict(max_slots=4, num_blocks=64, block_size=8,
+            max_blocks_per_seq=16, prefill_buckets=(16,),
+            max_prefills_per_step=4, decode_steps_per_iter=4)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _naive_greedy(params, prompt, n):
+    seq = list(prompt)
+    for _ in range(n):
+        logits = llama.forward_full(params, CFG, jnp.asarray([seq], jnp.int32))
+        seq.append(int(jnp.argmax(logits[0, -1])))
+    return seq[len(prompt):]
+
+
+def test_token_sink_emits_incrementally(params):
+    """The engine delivers tokens in waves (prefill first-token, then one
+    batch per fused decode call) before the final result."""
+    eng = InferenceEngine(CFG, params, EngineConfig(**ECFG), eos_id=-1)
+    calls = []
+    eng.token_sink = lambda rid, toks, res: calls.append((rid, list(toks), res))
+
+    eng.submit(GenerationRequest("a", [5, 6, 7], SamplingParams(max_tokens=10)))
+    while eng.has_work:
+        eng.step()
+
+    token_calls = [c for c in calls if c[1]]
+    result_calls = [c for c in calls if c[2] is not None]
+    assert len(result_calls) == 1 and result_calls[0][2].finish_reason == "length"
+    # prefill emits 1 token, then fused waves of <= decode_steps_per_iter.
+    assert len(token_calls) >= 3
+    assert token_calls[0][1] != [] and len(token_calls[0][1]) == 1
+    streamed = [t for _, toks, _ in token_calls for t in toks]
+    assert streamed == _naive_greedy(params, [5, 6, 7], 10)
+    # result arrives after every token was emitted
+    assert calls.index(result_calls[0]) == len(calls) - 1
+
+
+def test_concurrent_callers_share_batch(params):
+    """N threads blocking on generate() must share decode steps: the engine
+    executes far fewer steps than serial generation would."""
+    eng = InferenceEngine(CFG, params, EngineConfig(**ECFG), eos_id=-1)
+    svc = EngineService(eng)
+    rng = np.random.default_rng(0)
+    prompts = [list(rng.integers(3, 300, size=n)) for n in (5, 9, 3, 7)]
+    want = [_naive_greedy(params, p, 8) for p in prompts]
+
+    results = [None] * len(prompts)
+
+    def worker(i):
+        handle = svc.submit(prompts[i], SamplingParams(max_tokens=8))
+        results[i] = handle.result(timeout=120)
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(prompts))]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    svc.stop()
+
+    for r, w in zip(results, want):
+        assert r is not None and r.finish_reason == "length"
+        assert r.token_ids == w
+    # 4 requests x 8 tokens serially = 32+ decode steps; shared continuous
+    # batch does it in ~8 (one lane each).  Allow slack for ragged admission.
+    assert eng.steps <= 20, f"engine did not share decode steps: {eng.steps}"
+
+
+def test_stream_yields_tokens(params):
+    eng = InferenceEngine(CFG, params, EngineConfig(**ECFG), eos_id=-1)
+    svc = EngineService(eng)
+    handle = svc.submit([5, 6, 7], SamplingParams(max_tokens=10))
+    toks = list(handle.stream(timeout=120))
+    assert toks == _naive_greedy(params, [5, 6, 7], 10)
+    assert handle.result(timeout=5).finish_reason == "length"
+    svc.stop()
+
+
+def test_eos_not_streamed(params):
+    eng = InferenceEngine(CFG, params, EngineConfig(**ECFG), eos_id=-1)
+    svc = EngineService(eng)
+    free = _naive_greedy(params, [5, 6, 7], 20)
+    idx = next(i for i in range(3, len(free)) if free[i] not in free[:i])
+    eng.eos_id = free[idx]
+    # handle built after eos change so the filter sees the right id
+    handle = svc.submit([5, 6, 7], SamplingParams(max_tokens=20))
+    toks = list(handle.stream(timeout=120))
+    res = handle.result(timeout=5)
+    assert res.finish_reason == "eos"
+    assert toks == res.token_ids == free[:idx]
+    svc.stop()
