@@ -112,6 +112,32 @@ def main() -> None:
     log(f"p50 TTFT {p50 * 1e3:.1f} ms | p99 {p99 * 1e3:.1f} ms | "
         f"throughput {toks_per_s:.0f} tok/s | total {time.monotonic()-t0:.0f}s")
 
+    # BASELINE config #3: encoder embedding throughput (BGE-large geometry
+    # on TPU, tiny on CPU smoke runs), via the anomaly detector's batch path.
+    embed_docs_per_s = 0.0
+    try:
+        from k8s_llm_monitor_tpu.analysis.anomaly import EmbeddingAnomalyDetector
+        from k8s_llm_monitor_tpu.models.config import ENCODER_PRESETS
+
+        enc_name = os.environ.get(
+            "BENCH_ENCODER",
+            "bge-large" if dev.platform == "tpu" else "tiny-encoder")
+        det = EmbeddingAnomalyDetector(ENCODER_PRESETS[enc_name])
+        docs = [f"Warning: BackOff restarting failed container web-{i} "
+                f"in pod default/web-{i}; exit code 137 OOMKilled" * 3
+                for i in range(64)]
+        det.embed(docs)  # compile
+        et0 = time.monotonic()
+        reps = 5
+        for _ in range(reps):
+            emb = det.embed(docs)
+        embed_wall = time.monotonic() - et0
+        embed_docs_per_s = reps * len(docs) / embed_wall
+        log(f"encoder {enc_name}: {embed_docs_per_s:.0f} docs/s "
+            f"({len(docs)}-doc batches)")
+    except Exception as exc:  # noqa: BLE001 — extras never fail the bench
+        log(f"encoder bench skipped: {exc}")
+
     print(json.dumps({
         "metric": "p50_ttft_100c_ms",
         "value": round(p50 * 1e3, 2),
@@ -126,6 +152,7 @@ def main() -> None:
             "throughput_tok_s": round(toks_per_s, 1),
             "wall_s": round(wall, 2),
             "platform": dev.platform,
+            "embed_docs_per_s": round(embed_docs_per_s, 1),
         },
     }))
 

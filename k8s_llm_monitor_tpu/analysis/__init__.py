@@ -1,0 +1,5 @@
+"""Embedding-based analysis: the anomaly detector over cluster text streams."""
+
+from k8s_llm_monitor_tpu.analysis.anomaly import EmbeddingAnomalyDetector
+
+__all__ = ["EmbeddingAnomalyDetector"]

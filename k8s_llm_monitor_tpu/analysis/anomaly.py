@@ -1,0 +1,165 @@
+"""Embedding anomaly detector over cluster events and logs.
+
+Embeds text (events, log lines, symptom strings) with the BERT-family
+encoder (models/encoder.py, BASELINE.md config #3 — BGE-large on TPU) and
+flags semantic outliers by cosine distance from the batch centroid.  This
+upgrades the reference's thresholds-only anomaly surface (reference
+internal/metrics/manager.go:546-564 — fixed 80 %/90 % utilisation rules)
+with a content-aware signal: a burst of novel error text stands out even
+when every numeric gauge looks healthy.
+
+Batches are padded to power-of-two (B, S) buckets so the jitted encoder
+compiles a handful of shapes; the detector is CPU-tolerant (tiny encoder,
+tests) and TPU-ready (BGE-large weights via models/encoder.load_hf_encoder).
+"""
+
+from __future__ import annotations
+
+import re
+import zlib
+from typing import Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from k8s_llm_monitor_tpu.models import encoder
+from k8s_llm_monitor_tpu.models.config import ENCODER_PRESETS, EncoderConfig
+
+_WORD_RE = re.compile(r"[a-z0-9]+")
+
+
+class HashingTokenizer:
+    """Deterministic hashing word tokenizer for checkpoint-less encoders.
+
+    ids: 0 = pad, 1 = CLS, 2 = SEP, then crc32(word) hashed into the rest
+    of the vocab.  Stable across processes (unlike builtin ``hash``), which
+    keeps embeddings comparable between runs.
+    """
+
+    def __init__(self, vocab_size: int):
+        self.vocab_size = vocab_size
+
+    def encode(self, text: str, max_len: int) -> list[int]:
+        words = _WORD_RE.findall(text.lower())[: max_len - 2]
+        body = [3 + zlib.crc32(w.encode()) % (self.vocab_size - 3)
+                for w in words]
+        return [1] + body + [2]
+
+
+class EmbeddingAnomalyDetector:
+    """Embed texts; score each by cosine distance from the centroid."""
+
+    MAX_SEQ = 256
+
+    def __init__(
+        self,
+        cfg: EncoderConfig | None = None,
+        params=None,
+        tokenizer=None,
+        *,
+        pooling: str = "cls",
+        seed: int = 0,
+    ) -> None:
+        self.cfg = cfg or ENCODER_PRESETS["tiny-encoder"]
+        if params is None:
+            params = encoder.init_params(jax.random.PRNGKey(seed), self.cfg)
+        self.params = params
+        self.tokenizer = tokenizer or HashingTokenizer(self.cfg.vocab_size)
+        self.pooling = pooling
+        ecfg = self.cfg
+
+        def _encode(params, tokens, mask):
+            return encoder.encode(params, ecfg, tokens, mask, pooling=pooling)
+
+        self._encode = jax.jit(_encode)
+
+    @classmethod
+    def from_checkpoint(cls, path: str, **kw) -> "EmbeddingAnomalyDetector":
+        """BGE-large (or any BertModel) checkpoint directory; uses the HF
+        tokenizer when available."""
+        cfg, params = encoder.load_hf_encoder(path)
+        tokenizer = None
+        try:
+            from transformers import AutoTokenizer
+
+            hf_tok = AutoTokenizer.from_pretrained(path)
+
+            class _HFTok:
+                def encode(self, text: str, max_len: int) -> list[int]:
+                    return hf_tok.encode(text, truncation=True,
+                                         max_length=max_len)
+
+            tokenizer = _HFTok()
+        except Exception:  # noqa: BLE001 — hashing fallback
+            tokenizer = None
+        return cls(cfg, params, tokenizer, **kw)
+
+    # -- embedding ------------------------------------------------------
+
+    @staticmethod
+    def _pow2(n: int, cap: int) -> int:
+        p = 1
+        while p < n:
+            p *= 2
+        return min(p, cap)
+
+    def embed(self, texts: Sequence[str]) -> np.ndarray:
+        """[N, H] float32 L2-normalized embeddings."""
+        if not texts:
+            return np.zeros((0, self.cfg.hidden_size), np.float32)
+        ids = [self.tokenizer.encode(t, self.MAX_SEQ) for t in texts]
+        S = self._pow2(max(len(x) for x in ids), self.MAX_SEQ)
+        B = self._pow2(len(ids), 1 << 30)
+        tokens = np.zeros((B, S), np.int32)
+        mask = np.zeros((B, S), np.int32)
+        for i, x in enumerate(ids):
+            x = x[:S]
+            tokens[i, : len(x)] = x
+            mask[i, : len(x)] = 1
+        # padding rows need >= 1 unmasked token to keep softmax finite
+        mask[len(ids):, 0] = 1
+        out = self._encode(self.params, jnp.asarray(tokens), jnp.asarray(mask))
+        return np.asarray(out)[: len(ids)]
+
+    # -- scoring --------------------------------------------------------
+
+    def score(self, texts: Sequence[str]) -> list[float]:
+        """Cosine distance of each text from the batch centroid (0 = at the
+        centroid, up to 2 = antipodal)."""
+        emb = self.embed(texts)
+        if len(emb) == 0:
+            return []
+        centroid = emb.mean(axis=0)
+        norm = np.linalg.norm(centroid)
+        if norm < 1e-9:
+            return [0.0] * len(emb)
+        centroid = centroid / norm
+        return [float(1.0 - e @ centroid) for e in emb]
+
+    def flag_outliers(
+        self,
+        texts: Sequence[str],
+        threshold: float | None = None,
+    ) -> list[tuple[int, float]]:
+        """Indices + scores of semantic outliers.
+
+        Default threshold combines a z-score cut (mean + 2*std) with a
+        relative cut (2x the median distance), which makes it scale-free:
+        embedding geometries differ wildly between trained and random
+        encoders (random BERTs are strongly anisotropic — all scores tiny),
+        so no absolute distance floor works for both.  Needs >= 4 texts for
+        a meaningful distribution; fewer returns [].
+        """
+        if len(texts) < 4:
+            return []
+        scores = self.score(texts)
+        if threshold is None:
+            arr = np.asarray(scores)
+            threshold = max(
+                float(arr.mean() + 2.0 * arr.std()),
+                2.0 * float(np.median(arr)),
+                1e-9,
+            )
+        return [(i, s) for i, s in enumerate(scores) if s > threshold]

@@ -6,6 +6,7 @@ from k8s_llm_monitor_tpu.models.config import (
     EncoderConfig,
     ModelConfig,
 )
-from k8s_llm_monitor_tpu.models import llama
+from k8s_llm_monitor_tpu.models import encoder, llama
 
-__all__ = ["ModelConfig", "EncoderConfig", "PRESETS", "ENCODER_PRESETS", "llama"]
+__all__ = ["ModelConfig", "EncoderConfig", "PRESETS", "ENCODER_PRESETS",
+           "encoder", "llama"]

@@ -1,0 +1,243 @@
+"""BERT-family bidirectional text encoder (BGE-large), pure-functional JAX.
+
+The embedding backbone for the anomaly detector (analysis/anomaly.py,
+BASELINE.md config #3): cluster events and log lines are embedded and
+outliers flagged by cosine distance.  The reference has no embedding or
+anomaly model at all — its anomaly surface is fixed thresholds
+(reference internal/metrics/manager.go:546-564); this is part of the
+north-star Analysis Engine obligation.
+
+Architecture follows the BERT post-LayerNorm transformer exactly so HF
+``bge-large-en``/``bert-base`` safetensors load verbatim:
+  embeddings  = LN(word + position + token_type)
+  layer       = LN(x + attn(x)); LN(x + ffn(gelu))
+  pooling     = CLS token (BGE convention) or masked mean, L2-normalized.
+
+TPU notes: the whole forward is one jittable function of static shapes —
+pad batches to fixed (B, S) buckets; masked positions contribute nothing
+(attention bias -inf, pooling mask).  bf16-safe; LayerNorms run in f32.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from k8s_llm_monitor_tpu.models.config import EncoderConfig
+
+Params = dict[str, Any]
+
+NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def init_params(rng: jax.Array, cfg: EncoderConfig) -> Params:
+    dtype = jnp.dtype(cfg.dtype)
+    H, I = cfg.hidden_size, cfg.intermediate_size
+
+    def dense(key, in_f, out_f):
+        w = jax.random.normal(key, (in_f, out_f), jnp.float32) * 0.02
+        return {"kernel": w.astype(dtype), "bias": jnp.zeros((out_f,), dtype)}
+
+    def ln():
+        return {"scale": jnp.ones((H,), dtype), "bias": jnp.zeros((H,), dtype)}
+
+    keys = jax.random.split(rng, 4 + cfg.num_layers)
+    layers = []
+    for i in range(cfg.num_layers):
+        lk = jax.random.split(keys[4 + i], 6)
+        layers.append({
+            "q": dense(lk[0], H, H),
+            "k": dense(lk[1], H, H),
+            "v": dense(lk[2], H, H),
+            "attn_out": dense(lk[3], H, H),
+            "attn_ln": ln(),
+            "ffn_in": dense(lk[4], H, I),
+            "ffn_out": dense(lk[5], I, H),
+            "ffn_ln": ln(),
+        })
+    return {
+        "word_embed": (jax.random.normal(
+            keys[0], (cfg.vocab_size, H), jnp.float32) * 0.02).astype(dtype),
+        "pos_embed": (jax.random.normal(
+            keys[1], (cfg.max_position_embeddings, H),
+            jnp.float32) * 0.02).astype(dtype),
+        "type_embed": (jax.random.normal(
+            keys[2], (cfg.type_vocab_size, H), jnp.float32) * 0.02
+        ).astype(dtype),
+        "embed_ln": ln(),
+        "layers": layers,
+    }
+
+
+def _layer_norm(x: jnp.ndarray, p: Params, eps: float) -> jnp.ndarray:
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps)
+    return (out * p["scale"].astype(jnp.float32)
+            + p["bias"].astype(jnp.float32)).astype(x.dtype)
+
+
+def _dense(p: Params, x: jnp.ndarray) -> jnp.ndarray:
+    return x @ p["kernel"] + p["bias"]
+
+
+def forward(
+    params: Params,
+    cfg: EncoderConfig,
+    tokens: jnp.ndarray,
+    mask: jnp.ndarray,
+) -> jnp.ndarray:
+    """Token-level hidden states.
+
+    Args:
+      tokens: [B, S] int32 (right-padded).
+      mask: [B, S] — 1 for real tokens, 0 for padding.
+
+    Returns:
+      [B, S, H] hidden states (padding positions are garbage; mask them).
+    """
+    B, S = tokens.shape
+    H, nH, D = cfg.hidden_size, cfg.num_heads, cfg.head_dim
+
+    pos = jnp.arange(S, dtype=jnp.int32)
+    x = (params["word_embed"][tokens]
+         + params["pos_embed"][pos][None, :, :]
+         + params["type_embed"][jnp.zeros((B, S), jnp.int32)])
+    x = _layer_norm(x, params["embed_ln"], cfg.layer_norm_eps)
+
+    # additive attention bias: padding keys masked out for every query
+    bias = jnp.where(mask[:, None, None, :] > 0, 0.0, NEG_INF)  # [B,1,1,S]
+    scale = 1.0 / (D ** 0.5)
+
+    for layer in params["layers"]:
+        q = _dense(layer["q"], x).reshape(B, S, nH, D)
+        k = _dense(layer["k"], x).reshape(B, S, nH, D)
+        v = _dense(layer["v"], x).reshape(B, S, nH, D)
+        logits = jnp.einsum("bshd,bthd->bhst",
+                            q.astype(jnp.float32), k.astype(jnp.float32))
+        logits = logits * scale + bias
+        probs = jax.nn.softmax(logits, axis=-1)
+        attn = jnp.einsum("bhst,bthd->bshd", probs, v.astype(jnp.float32))
+        attn = attn.astype(x.dtype).reshape(B, S, H)
+        x = _layer_norm(x + _dense(layer["attn_out"], attn),
+                        layer["attn_ln"], cfg.layer_norm_eps)
+        h = jax.nn.gelu(_dense(layer["ffn_in"], x), approximate=False)
+        x = _layer_norm(x + _dense(layer["ffn_out"], h),
+                        layer["ffn_ln"], cfg.layer_norm_eps)
+    return x
+
+
+def encode(
+    params: Params,
+    cfg: EncoderConfig,
+    tokens: jnp.ndarray,
+    mask: jnp.ndarray,
+    *,
+    pooling: str = "cls",
+) -> jnp.ndarray:
+    """Sentence embeddings: pooled + L2-normalized, [B, H] float32.
+
+    ``pooling``: "cls" (BGE convention — first token) or "mean" (masked).
+    """
+    hidden = forward(params, cfg, tokens, mask).astype(jnp.float32)
+    if pooling == "cls":
+        pooled = hidden[:, 0, :]
+    elif pooling == "mean":
+        m = mask.astype(jnp.float32)[:, :, None]
+        pooled = jnp.sum(hidden * m, axis=1) / jnp.maximum(
+            jnp.sum(m, axis=1), 1.0)
+    else:
+        raise ValueError(f"unknown pooling {pooling!r}")
+    norm = jnp.linalg.norm(pooled, axis=-1, keepdims=True)
+    return pooled / jnp.maximum(norm, 1e-9)
+
+
+# ---------------------------------------------------------------------------
+# HF checkpoint loading (BertModel layout: bge-large-en, bert-base, ...)
+# ---------------------------------------------------------------------------
+
+_HF_LAYER_MAP = {
+    "attention.self.query": "q",
+    "attention.self.key": "k",
+    "attention.self.value": "v",
+    "attention.output.dense": "attn_out",
+    "intermediate.dense": "ffn_in",
+    "output.dense": "ffn_out",
+}
+
+
+def params_from_hf_state(state: dict[str, Any], cfg: EncoderConfig) -> Params:
+    """Convert an HF BertModel state dict (numpy arrays) to our tree.
+
+    Accepts both bare (``embeddings.word_embeddings.weight``) and prefixed
+    (``bert.embeddings...``) key styles.  Linear weights are transposed to
+    the ``[in, out]`` layout the forward uses.
+    """
+    import numpy as np
+
+    def get(key):
+        for k in (key, "bert." + key):
+            if k in state:
+                return np.asarray(state[k])
+        raise KeyError(key)
+
+    dtype = jnp.dtype(cfg.dtype)
+
+    def dense(prefix):
+        return {
+            "kernel": jnp.asarray(get(prefix + ".weight").T, dtype),
+            "bias": jnp.asarray(get(prefix + ".bias"), dtype),
+        }
+
+    def ln(prefix):
+        return {
+            "scale": jnp.asarray(get(prefix + ".weight"), dtype),
+            "bias": jnp.asarray(get(prefix + ".bias"), dtype),
+        }
+
+    layers = []
+    for i in range(cfg.num_layers):
+        base = f"encoder.layer.{i}."
+        layer = {ours: dense(base + hf) for hf, ours in _HF_LAYER_MAP.items()}
+        layer["attn_ln"] = ln(base + "attention.output.LayerNorm")
+        layer["ffn_ln"] = ln(base + "output.LayerNorm")
+        layers.append(layer)
+    return {
+        "word_embed": jnp.asarray(
+            get("embeddings.word_embeddings.weight"), dtype),
+        "pos_embed": jnp.asarray(
+            get("embeddings.position_embeddings.weight"), dtype),
+        "type_embed": jnp.asarray(
+            get("embeddings.token_type_embeddings.weight"), dtype),
+        "embed_ln": ln("embeddings.LayerNorm"),
+        "layers": layers,
+    }
+
+
+def load_hf_encoder(path: str) -> tuple[EncoderConfig, Params]:
+    """Load a BertModel-family checkpoint directory (config.json +
+    safetensors) into (EncoderConfig, params)."""
+    import json
+    import os
+    import pathlib
+
+    from k8s_llm_monitor_tpu.utils.checkpoint import _SafetensorsDict
+
+    with open(os.path.join(path, "config.json"), encoding="utf-8") as fh:
+        hf = json.load(fh)
+    cfg = EncoderConfig(
+        name=hf.get("_name_or_path", "hf-encoder"),
+        vocab_size=hf["vocab_size"],
+        hidden_size=hf["hidden_size"],
+        intermediate_size=hf["intermediate_size"],
+        num_layers=hf["num_hidden_layers"],
+        num_heads=hf["num_attention_heads"],
+        max_position_embeddings=hf["max_position_embeddings"],
+        type_vocab_size=hf.get("type_vocab_size", 2),
+        layer_norm_eps=hf.get("layer_norm_eps", 1e-12),
+    )
+    state = _SafetensorsDict(pathlib.Path(path))
+    return cfg, params_from_hf_state(state, cfg)

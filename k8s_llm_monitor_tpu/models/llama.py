@@ -168,8 +168,16 @@ def forward_full(
     tokens: jnp.ndarray,
     *,
     positions: Optional[jnp.ndarray] = None,
+    attn_fn=None,
 ) -> jnp.ndarray:
-    """Dense causal forward.  tokens [B, S] -> logits [B, S, V] (float32)."""
+    """Dense causal forward.  tokens [B, S] -> logits [B, S, V] (float32).
+
+    ``attn_fn`` swaps the attention implementation (default dense
+    ``causal_attention``; pass ``parallel.ring_attention.make_ring_attention``
+    output for sequence-parallel long-context training).
+    """
+    if attn_fn is None:
+        attn_fn = causal_attention
     B, S = tokens.shape
     x = params["embed"]["weight"][tokens]
     if positions is None:
@@ -179,7 +187,7 @@ def forward_full(
     for layer in params["layers"]:
         h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(layer, cfg, h, cos, sin)
-        attn = causal_attention(q, k, v, q_positions=positions)
+        attn = attn_fn(q, k, v, q_positions=positions)
         x = x + _linear(layer["o"], attn.reshape(B, S, -1))
         h = rms_norm(x, layer["post_norm"], cfg.rms_norm_eps)
         x = x + _mlp(layer, h)

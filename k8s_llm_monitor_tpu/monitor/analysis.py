@@ -110,12 +110,21 @@ class LocalEngineBackend(LLMBackend):
     # Generations that outlive this are failed (queue + decode worst case).
     GENERATION_TIMEOUT_S = 600.0
 
-    def __init__(self, engine, tokenizer) -> None:
+    def __init__(self, engine, tokenizer, *, dev_weights: bool = False) -> None:
         from k8s_llm_monitor_tpu.serving.service import EngineService
 
         self.engine = engine
         self.tokenizer = tokenizer
         self.service = EngineService(engine)
+        if dev_weights:
+            # Random-init weights + byte tokenizer produce byte soup; make
+            # that loud in every API response's `model` field instead of
+            # presenting it as a real diagnosis backend.
+            self.name = "tpu-local-DEV-RANDOM-WEIGHTS"
+            logger.warning(
+                "TPU backend running with RANDOM-INIT weights (no "
+                "llm.tpu.checkpoint configured) — answers are not "
+                "meaningful; set llm.tpu.checkpoint for real diagnosis")
 
     @classmethod
     def from_config(cls, tpu_cfg) -> "LocalEngineBackend":
@@ -128,6 +137,7 @@ class LocalEngineBackend(LLMBackend):
         from k8s_llm_monitor_tpu.serving.engine import EngineConfig, InferenceEngine
         from k8s_llm_monitor_tpu.utils.tokenizer import load_tokenizer
 
+        dev_weights = not tpu_cfg.checkpoint
         if tpu_cfg.checkpoint:
             from k8s_llm_monitor_tpu.utils.checkpoint import load_hf_checkpoint
 
@@ -152,7 +162,7 @@ class LocalEngineBackend(LLMBackend):
             tokenizer=tokenizer,
             mesh=mesh,
         )
-        return cls(engine, tokenizer)
+        return cls(engine, tokenizer, dev_weights=dev_weights)
 
     def generate(
         self, prompt: str, max_tokens: int = 512, temperature: float = 0.1
@@ -184,16 +194,22 @@ class LocalEngineBackend(LLMBackend):
         )
         toks: list[int] = []
         emitted = ""
-        for tok in handle.stream(timeout=self.GENERATION_TIMEOUT_S):
-            toks.append(tok)
-            text = self.tokenizer.decode(toks)
-            # Hold back a trailing replacement char: it usually means a
-            # multi-byte grapheme is split mid-token and the next token will
-            # rewrite it.
-            stable = text[:-1] if text.endswith("�") else text
-            if len(stable) > len(emitted) and stable.startswith(emitted):
-                yield stable[len(emitted):]
-                emitted = stable
+        try:
+            for tok in handle.stream(timeout=self.GENERATION_TIMEOUT_S):
+                toks.append(tok)
+                text = self.tokenizer.decode(toks)
+                # Hold back a trailing replacement char: it usually means a
+                # multi-byte grapheme is split mid-token and the next token
+                # will rewrite it.
+                stable = text[:-1] if text.endswith("�") else text
+                if len(stable) > len(emitted) and stable.startswith(emitted):
+                    yield stable[len(emitted):]
+                    emitted = stable
+        except GeneratorExit:
+            # Consumer abandoned the stream (client disconnect): stop the
+            # engine from burning decode steps on a dead request.
+            handle.cancel()
+            raise
         # Final flush: emit whatever the full decode has beyond (or instead
         # of) what was streamed, so held-back or rewritten tails are never
         # silently dropped.
@@ -412,6 +428,7 @@ class AnalysisEngine:
         manager: Manager | None = None,
         cfg: AnalysisConfig | None = None,
         llm_cfg: LLMConfig | None = None,
+        anomaly_detector=None,
     ) -> None:
         self.backend = backend
         self.client = client
@@ -419,6 +436,10 @@ class AnalysisEngine:
         self.cfg = cfg or AnalysisConfig()
         self.llm_cfg = llm_cfg or LLMConfig()
         self.evidence = EvidenceCollector(client, manager, self.cfg)
+        # analysis.anomaly.EmbeddingAnomalyDetector (optional): adds
+        # content-aware outlier detection over event text to the
+        # thresholds-only anomaly signals.
+        self.anomaly_detector = anomaly_detector
 
     # -- free-form NL question (the missing /api/v1/query) ---------------------
 
@@ -453,6 +474,26 @@ class AnalysisEngine:
                 error=str(exc),
                 error_kind="internal",
             )
+
+    def query_stream(self, question: str):
+        """Streaming variant of query(): returns (request_id, model_name,
+        iterator of answer-text chunks).  Evidence collection happens up
+        front (before the first chunk); generation streams from the backend
+        as tokens come off the device (LocalEngineBackend) or as one chunk
+        (backends without true streaming)."""
+        request_id = uuid.uuid4().hex[:12]
+        ev = self.evidence.collect()
+        prompt = (
+            _SYSTEM_PREAMBLE
+            + self.evidence.format_prompt(ev)
+            + f"\n## Question\n{question}\n## Answer\n"
+        )
+        chunks = self.backend.generate_stream(
+            prompt,
+            max_tokens=self.llm_cfg.max_tokens,
+            temperature=self.llm_cfg.temperature,
+        )
+        return request_id, self.backend.name, chunks
 
     # -- typed analyses (ref pkg/models/models.go:85-99) ------------------------
 
@@ -542,6 +583,20 @@ class AnalysisEngine:
             f"UAV on {u['node']} battery {u['battery_pct']}%"
             for u in ev.get("low_battery_uavs", [])
         ]
+        embedding_outliers: list[dict[str, Any]] = []
+        if self.anomaly_detector is not None:
+            events = ev.get("recent_warning_events", [])
+            texts = [f"{e.get('reason', '')}: {e.get('message', '')}"
+                     for e in events]
+            try:
+                for idx, score in self.anomaly_detector.flag_outliers(texts):
+                    embedding_outliers.append(
+                        {"event": texts[idx], "score": round(score, 4)})
+                    anomalies.append(
+                        f"semantic outlier event (score {score:.2f}): "
+                        f"{texts[idx]}")
+            except Exception as exc:  # noqa: BLE001 — detector is best-effort
+                logger.warning("embedding anomaly scoring failed: %s", exc)
         prompt = (
             _SYSTEM_PREAMBLE
             + self.evidence.format_prompt(ev)
@@ -555,6 +610,7 @@ class AnalysisEngine:
         return {
             "anomalies": anomalies,
             "anomaly_count": len(anomalies),
+            "embedding_outliers": embedding_outliers,
             "llm_summary": summary,
             "model": self.backend.name,
         }

@@ -111,6 +111,10 @@ class AnalysisConfig:
     enable_prediction: bool = True  # ref config.go default
     enable_auto_fix: bool = False
     max_context_events: int = 100
+    # Embedding anomaly detector (analysis/anomaly.py): "" disables;
+    # an ENCODER_PRESETS name ("tiny-encoder", "bge-large") random-inits;
+    # a directory path loads a BertModel-family HF checkpoint.
+    embedding_model: str = ""
 
 
 @dataclass

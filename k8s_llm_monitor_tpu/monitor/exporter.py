@@ -1,0 +1,150 @@
+"""Prometheus text-format self-observability exporter.
+
+The reference has no ``/metrics`` endpoint — its self-observability is
+logrus lines only (SURVEY §5.5; reference internal/metrics/manager.go:317-319
+logs per-collection durations and nothing is scrapeable).  This module
+renders the monitor's own health as Prometheus exposition text (version
+0.0.4) for the ``GET /metrics`` route:
+
+  * serving engine gauges/counters: queue depth, active slots, free KV
+    blocks, prefill/decode-step/preemption totals, TTFT histogram;
+  * metrics-manager collection stats and snapshot sizes;
+  * TPU/accelerator gauges (device kind, HBM bytes in use) when a JAX
+    device is live — ``jax.local_devices()[0].memory_stats()``.
+
+No client library: exposition text is trivial to emit and the zero-dep
+constraint (stdlib + jax only) matches the rest of the monitor plane.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # pragma: no cover
+    from k8s_llm_monitor_tpu.monitor.server import MonitorServer
+
+_PREFIX = "k8s_llm_monitor"
+
+
+class _Writer:
+    def __init__(self) -> None:
+        self.lines: list[str] = []
+
+    def metric(self, name: str, mtype: str, help_: str,
+               samples: list[tuple[str, float]]) -> None:
+        """samples: [(labels_suffix_or_empty, value)]"""
+        full = f"{_PREFIX}_{name}"
+        self.lines.append(f"# HELP {full} {help_}")
+        self.lines.append(f"# TYPE {full} {mtype}")
+        for labels, value in samples:
+            if isinstance(value, float) and math.isinf(value):
+                value = "+Inf" if value > 0 else "-Inf"
+            self.lines.append(f"{full}{labels} {value}")
+
+    def render(self) -> str:
+        return "\n".join(self.lines) + "\n"
+
+
+def _engine_metrics(w: _Writer, engine) -> None:
+    w.metric("engine_queue_depth", "gauge",
+             "Requests waiting for admission",
+             [("", engine.queue_depth)])
+    w.metric("engine_active_slots", "gauge",
+             "Decode lanes currently occupied",
+             [("", engine.active_slots)])
+    w.metric("engine_slots_total", "gauge",
+             "Configured decode lanes",
+             [("", engine.ecfg.max_slots)])
+    w.metric("engine_free_kv_blocks", "gauge",
+             "KV cache blocks available in the pool",
+             [("", engine.allocator.free_blocks)])
+    w.metric("engine_kv_blocks_total", "gauge",
+             "Configured KV cache blocks",
+             [("", engine.ecfg.num_blocks)])
+    w.metric("engine_prefills_total", "counter",
+             "Prompts ingested via prefill",
+             [("", engine.prefills)])
+    w.metric("engine_decode_steps_total", "counter",
+             "Device decode steps executed",
+             [("", engine.steps)])
+    w.metric("engine_preemptions_total", "counter",
+             "Recompute-preemptions under KV pressure",
+             [("", engine.preemptions)])
+
+    # Prometheus histogram: cumulative buckets + sum + count.
+    cumulative = 0
+    samples = []
+    for le, n in zip(engine.ttft_buckets, engine.ttft_counts):
+        cumulative += n
+        samples.append((f'_bucket{{le="{le}"}}', cumulative))
+    cumulative += engine.ttft_counts[-1]
+    samples.append(('_bucket{le="+Inf"}', cumulative))
+    w.metric("engine_ttft_seconds", "histogram",
+             "Time to first token per request", samples)
+    w.lines.append(f"{_PREFIX}_engine_ttft_seconds_sum {engine.ttft_sum}")
+    w.lines.append(f"{_PREFIX}_engine_ttft_seconds_count {engine.ttft_count}")
+
+
+def _manager_metrics(w: _Writer, manager) -> None:
+    w.metric("collections_total", "counter",
+             "Metrics collection cycles completed",
+             [("", manager.collect_count)])
+    w.metric("collection_duration_seconds", "gauge",
+             "Duration of the most recent collection cycle",
+             [("", round(manager.last_collect_duration, 6))])
+    snap = manager.get_latest_snapshot()
+    w.metric("snapshot_nodes", "gauge", "Nodes in the latest snapshot",
+             [("", len(snap.node_metrics))])
+    w.metric("snapshot_pods", "gauge", "Pods in the latest snapshot",
+             [("", len(snap.pod_metrics))])
+    w.metric("snapshot_network_pairs", "gauge",
+             "Probed pod pairs in the latest snapshot",
+             [("", len(snap.network_metrics))])
+    w.metric("snapshot_uavs", "gauge", "UAVs in the latest snapshot",
+             [("", len(manager.get_uav_metrics()))])
+
+
+def _device_metrics(w: _Writer) -> None:
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:  # noqa: BLE001 — no backend available
+        return
+    samples_used, samples_total = [], []
+    for d in devices:
+        stats = {}
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:  # noqa: BLE001 — backend without memory_stats
+            pass
+        label = f'{{device="{d.id}",kind="{d.device_kind}"}}'
+        if "bytes_in_use" in stats:
+            samples_used.append((label, stats["bytes_in_use"]))
+        if "bytes_limit" in stats:
+            samples_total.append((label, stats["bytes_limit"]))
+    if samples_used:
+        w.metric("device_memory_used_bytes", "gauge",
+                 "Accelerator (HBM) bytes in use", samples_used)
+    if samples_total:
+        w.metric("device_memory_limit_bytes", "gauge",
+                 "Accelerator (HBM) byte limit", samples_total)
+    w.metric("devices", "gauge", "Visible accelerator devices",
+             [("", len(devices))])
+
+
+def render_prometheus(srv: "MonitorServer") -> str:
+    w = _Writer()
+    w.metric("build_info", "gauge", "Monitor build info",
+             [('{version="1.0.0"}', 1)])
+    engine = None
+    if srv.analysis is not None:
+        backend = getattr(srv.analysis, "backend", None)
+        engine = getattr(backend, "engine", None)
+    if engine is not None:
+        _engine_metrics(w, engine)
+    if srv.manager is not None:
+        _manager_metrics(w, srv.manager)
+    _device_metrics(w)
+    return w.render()

@@ -229,21 +229,31 @@ class KubeRestBackend(ClusterBackend):
                 return tmp.name
             return src.get(file_key)
 
-        ctx_ssl: ssl.SSLContext | None = None
-        if server.startswith("https"):
-            ctx_ssl = ssl.create_default_context()
-            ca = _materialize("certificate-authority-data",
-                              "certificate-authority", cluster)
-            if ca:
-                ctx_ssl.load_verify_locations(cafile=ca)
-            if cluster.get("insecure-skip-tls-verify"):
-                ctx_ssl.check_hostname = False
-                ctx_ssl.verify_mode = ssl.CERT_NONE
-            cert = _materialize("client-certificate-data",
-                                "client-certificate", user)
-            key = _materialize("client-key-data", "client-key", user)
-            if cert and key:
-                ctx_ssl.load_cert_chain(certfile=cert, keyfile=key)
+        try:
+            ctx_ssl: ssl.SSLContext | None = None
+            if server.startswith("https"):
+                ctx_ssl = ssl.create_default_context()
+                ca = _materialize("certificate-authority-data",
+                                  "certificate-authority", cluster)
+                if ca:
+                    ctx_ssl.load_verify_locations(cafile=ca)
+                if cluster.get("insecure-skip-tls-verify"):
+                    ctx_ssl.check_hostname = False
+                    ctx_ssl.verify_mode = ssl.CERT_NONE
+                cert = _materialize("client-certificate-data",
+                                    "client-certificate", user)
+                key = _materialize("client-key-data", "client-key", user)
+                if cert and key:
+                    ctx_ssl.load_cert_chain(certfile=cert, keyfile=key)
+        except Exception:
+            # Don't leave decoded key material behind when construction
+            # fails before close() is registered.
+            for p in tmpfiles:
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+            raise
 
         token = user.get("token")
         backend.__init__(server, token=token, ssl_context=ctx_ssl)
@@ -413,8 +423,12 @@ class KubeRestBackend(ClusterBackend):
             + [("stdout", "true"), ("stderr", "true"),
                ("stdin", "false"), ("tty", "false")],
         )
-        path = f"/api/v1/namespaces/{namespace}/pods/{pod}/exec?{query}"
         u = urllib.parse.urlparse(self.base_url)
+        # Preserve any path prefix (proxied API servers, e.g. /k8s/clusters/x)
+        # just like _request's base_url + path concatenation.
+        prefix = u.path.rstrip("/")
+        path = (f"{prefix}/api/v1/namespaces/{namespace}/pods/{pod}/exec"
+                f"?{query}")
         host = u.hostname or "localhost"
         port = u.port or (443 if u.scheme == "https" else 80)
 

@@ -314,6 +314,15 @@ class Manager:
             entry = self._uav_snapshot.get(node_name)
             return dict(entry) if entry is not None else None
 
+    def send_uav_command(
+        self, node: str, command: str, params: dict[str, Any] | None = None
+    ) -> dict[str, Any]:
+        """Push a flight command to a node's UAV agent (ref SendCommandToUAV,
+        uav_metrics.go:236-287).  Requires the UAV source to be enabled."""
+        if self.uav_source is None:
+            raise ValueError("UAV metrics source is disabled")
+        return self.uav_source.send_command(node, command, params)
+
     def uav_heartbeats(self) -> dict[str, datetime]:
         """Derived from the snapshot entries — single source of truth."""
         with self._lock:

@@ -113,6 +113,8 @@ class MonitorServer:
 # getattr because handler instances are created per connection)
 _ROUTES: dict[tuple[str, str], str] = {
     ("GET", "/health"): "h_health",
+    ("GET", "/metrics"): "h_prometheus",
+    ("POST", "/debug/profile"): "h_profile",
     ("GET", "/api/v1/cluster/status"): "h_cluster_status",
     ("GET", "/api/v1/pods"): "h_pods",
     ("POST", "/api/v1/analyze/pod-communication"): "h_pod_comm",
@@ -125,6 +127,7 @@ _ROUTES: dict[tuple[str, str], str] = {
     ("GET", "/api/v1/metrics/network"): "h_metrics_network",
     ("GET", "/api/v1/metrics/uav"): "h_metrics_uav",
     ("POST", "/api/v1/uav/report"): "h_uav_report",
+    ("POST", "/api/v1/uav/command"): "h_uav_command",
     ("GET", "/api/v1/crd/uav"): "h_uav_crd",
 }
 _ROUTE_PATHS = {p for _, p in _ROUTES}
@@ -236,6 +239,40 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
                 {"status": "healthy", "timestamp": _now(), "version": VERSION}
             )
 
+        def h_prometheus(self) -> None:
+            # Self-observability the reference never had (SURVEY §5.5):
+            # engine/manager/device gauges in Prometheus text format.
+            from k8s_llm_monitor_tpu.monitor.exporter import render_prometheus
+
+            body = render_prometheus(srv).encode()
+            self.send_response(200)
+            self.send_header(
+                "Content-Type", "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def h_profile(self) -> None:
+            """Capture a jax.profiler trace (debug mode only): body
+            {"seconds": N, "dir": path?} -> {"trace_dir": ...}."""
+            if not srv.config.server.debug:
+                return self._send_error_text(
+                    "profiling requires server.debug=true", 403)
+            try:
+                body = self._read_json() or {}
+            except ValueError:
+                return self._send_error_text("Invalid JSON body", 400)
+            seconds = min(float(body.get("seconds", 2.0)), 60.0)
+            trace_dir = body.get("dir") or "/tmp/k8s-llm-monitor-trace"
+            import time as _time
+
+            import jax
+
+            jax.profiler.start_trace(trace_dir)
+            _time.sleep(seconds)
+            jax.profiler.stop_trace()
+            self._send_json({"trace_dir": trace_dir, "seconds": seconds})
+
         def h_cluster_status(self) -> None:
             if srv.client is None:
                 return self._send_json(
@@ -338,8 +375,51 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
             question = (body.get("question") or "").strip()
             if not question:
                 return self._send_error_text("question is required", 400)
+            if body.get("stream"):
+                return self._stream_query(question)
             resp = srv.analysis.query(question)
             self._send_json(resp, status=200 if resp.status == "success" else 500)
+
+        def _stream_query(self, question: str) -> None:
+            """Server-sent events: one `data:` JSON per answer-text delta as
+            tokens come off the device, then a final done event.  TTFT is
+            real for clients here — the first delta arrives while the rest
+            of the answer is still decoding."""
+            try:
+                request_id, model, chunks = srv.analysis.query_stream(question)
+            except Exception as exc:  # noqa: BLE001 — before headers: 500
+                return self._send_error_text(f"query failed: {exc}", 500)
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def event(payload: dict[str, Any]) -> None:
+                data = f"data: {json.dumps(payload)}\n\n".encode()
+                self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                self.wfile.flush()
+
+            try:
+                for chunk in chunks:
+                    event({"request_id": request_id, "delta": chunk})
+                event({"request_id": request_id, "done": True, "model": model})
+            except BrokenPipeError:
+                # Client went away mid-stream: close the generator so the
+                # backend cancels the in-flight generation.
+                if hasattr(chunks, "close"):
+                    chunks.close()
+                return
+            except Exception as exc:  # noqa: BLE001 — headers already sent
+                try:
+                    event({"request_id": request_id, "error": str(exc)})
+                except BrokenPipeError:
+                    return
+            try:
+                self.wfile.write(b"0\r\n\r\n")
+                self.wfile.flush()
+            except BrokenPipeError:
+                pass
 
         def h_analyze(self) -> None:
             if srv.analysis is None:
@@ -476,6 +556,42 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
 
         # -- UAV report ingestion (ref :569-645) --------------------------------
 
+        def h_uav_command(self) -> None:
+            """Push a flight command to a node's UAV agent — the server-side
+            surface the reference's SendCommandToUAV lacked (its payload
+            marshaling was an unfinished TODO, ref uav_metrics.go:254-266,
+            and no HTTP route ever called it)."""
+            if srv.manager is None:
+                return self._send_json(
+                    {"status": "warning",
+                     "message": "Metrics manager not available - running "
+                                "in development mode"},
+                    503,
+                )
+            try:
+                body = self._read_json() or {}
+            except ValueError:
+                return self._send_error_text("Invalid JSON body", 400)
+            node = body.get("node", "")
+            command = body.get("command", "")
+            if not node or not command:
+                return self._send_error_text("node and command are required", 400)
+            if command not in ("arm", "disarm", "takeoff", "land", "rtl", "mode"):
+                return self._send_error_text(
+                    f"unknown command {command!r}", 400)
+            if srv.manager.uav_source is None:
+                return self._send_error_text(
+                    "UAV metrics source is disabled", 503)
+            try:
+                result = srv.manager.send_uav_command(
+                    node, command, body.get("params") or {})
+            except ValueError as exc:
+                return self._send_error_text(str(exc), 404)
+            except Exception as exc:  # noqa: BLE001 — agent unreachable
+                return self._send_error_text(f"command failed: {exc}", 502)
+            self._send_json({"status": "success", "node": node,
+                             "command": command, "agent_response": result})
+
         def h_uav_report(self) -> None:
             try:
                 body = self._read_json() or {}
@@ -594,12 +710,30 @@ def build_server(
     if client is not None and config.metrics.enabled:
         manager = Manager(client, config.metrics, uav_fetcher=uav_fetcher)
     llm_backend = build_backend(config.llm)
+    detector = None
+    if config.analysis.embedding_model:
+        try:
+            from k8s_llm_monitor_tpu.analysis.anomaly import (
+                EmbeddingAnomalyDetector,
+            )
+            from k8s_llm_monitor_tpu.models.config import ENCODER_PRESETS
+
+            name = config.analysis.embedding_model
+            if name in ENCODER_PRESETS:
+                detector = EmbeddingAnomalyDetector(ENCODER_PRESETS[name])
+            else:
+                detector = EmbeddingAnomalyDetector.from_checkpoint(name)
+        except Exception as exc:  # noqa: BLE001 — degrade, never fail boot
+            logger.warning(
+                "embedding detector unavailable (%s) - thresholds only", exc
+            )
     analysis = AnalysisEngine(
         llm_backend,
         client=client,
         manager=manager,
         cfg=config.analysis,
         llm_cfg=config.llm,
+        anomaly_detector=detector,
     )
     return MonitorServer(
         config=config,

@@ -306,6 +306,17 @@ def http_state_fetcher(url: str) -> dict[str, Any]:
         return json.loads(resp.read())
 
 
+def http_command_poster(url: str, payload: dict[str, Any]) -> dict[str, Any]:
+    req = urllib.request.Request(
+        url,
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"},
+        method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        return json.loads(resp.read())
+
+
 class UAVMetricsSource:
     """Pulls UAV state from per-node agent pods (``app=uav-agent``)."""
 
@@ -315,10 +326,12 @@ class UAVMetricsSource:
         namespace: str = "default",
         fetcher: StateFetcher | None = None,
         port: int = UAV_AGENT_PORT,
+        poster=None,
     ) -> None:
         self.client = client
         self.namespace = namespace
         self.fetcher = fetcher or http_state_fetcher
+        self.poster = poster or http_command_poster
         self.port = port
 
     def agent_pods(self):
@@ -354,3 +367,19 @@ class UAVMetricsSource:
         for t in threads:
             t.join(timeout=10)
         return out
+
+    def send_command(
+        self, node: str, command: str, params: dict[str, Any] | None = None
+    ) -> dict[str, Any]:
+        """Push a flight command to the agent on ``node`` (ref
+        uav_metrics.go:236-287 SendCommandToUAV — whose payload marshaling
+        was an unfinished TODO, :254-266; here the body is actually sent).
+
+        Commands map to the agent API: arm/disarm/takeoff/land/rtl/mode
+        (monitor/agent.py)."""
+        pod = next(
+            (p for p in self.agent_pods() if p.node_name == node), None)
+        if pod is None:
+            raise ValueError(f"no running uav-agent pod on node {node!r}")
+        url = f"http://{pod.ip}:{self.port}/api/v1/command/{command}"
+        return self.poster(url, params or {})

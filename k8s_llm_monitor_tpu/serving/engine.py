@@ -112,7 +112,8 @@ class EngineConfig:
 
 class _Slot:
     __slots__ = ("req", "blocks", "ctx_len", "generated", "pending_admit",
-                 "inflight_decode", "first_token_time", "retired")
+                 "inflight_decode", "first_token_time", "retired",
+                 "cancel_requested")
 
     def __init__(self, req: GenerationRequest, blocks: list[int]):
         self.req = req
@@ -123,6 +124,7 @@ class _Slot:
         self.inflight_decode = 0         # decode steps dispatched, unreconciled
         self.first_token_time = 0.0
         self.retired = False
+        self.cancel_requested = False
 
     # -- predicted (dispatch-side) state --------------------------------
 
@@ -274,6 +276,13 @@ class InferenceEngine:
         self.steps = 0
         self.prefills = 0
         self.preemptions = 0
+        # TTFT histogram (Prometheus semantics: cumulative le buckets +
+        # sum/count), observed once per request at admission reconcile.
+        self.ttft_buckets: tuple[float, ...] = (
+            0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0)
+        self.ttft_counts = [0] * (len(self.ttft_buckets) + 1)  # +Inf last
+        self.ttft_sum = 0.0
+        self.ttft_count = 0
 
     # ------------------------------------------------------------------
     # public API
@@ -322,6 +331,23 @@ class InferenceEngine:
 
     def poll(self, request_id: str) -> Optional[GenerationResult]:
         return self._results.pop(request_id, None)
+
+    def cancel(self, request_id: str) -> bool:
+        """Stop generating for a request (client went away).
+
+        Pending requests are failed immediately; an active slot is marked
+        and retired at its next reconcile (its in-flight device steps finish
+        but no new ones are dispatched).  Returns True if found."""
+        for i, req in enumerate(self._pending):
+            if req.request_id == request_id:
+                del self._pending[i]
+                self._fail_request(req, "cancelled")
+                return True
+        for s in self._slots:
+            if s is not None and s.req.request_id == request_id:
+                s.cancel_requested = True
+                return True
+        return False
 
     @property
     def has_work(self) -> bool:
@@ -650,8 +676,16 @@ class InferenceEngine:
         ec = self.ecfg
         B = ec.max_slots
 
+        # Retire cancelled lanes that have fully settled; exclude the rest
+        # from new dispatches (their in-flight steps drain via reconcile).
+        for i, s in enumerate(self._slots):
+            if (s is not None and s.cancel_requested
+                    and not s.pending_admit and s.inflight_decode == 0):
+                self._retire(i)
+
         lanes = [(i, s) for i, s in enumerate(self._slots)
-                 if s is not None and s.remaining_pred > 0]
+                 if s is not None and s.remaining_pred > 0
+                 and not s.cancel_requested]
         if not lanes:
             return False
 
@@ -691,7 +725,8 @@ class InferenceEngine:
                             break
 
         lanes = [(i, s) for i, s in enumerate(self._slots)
-                 if s is not None and not s.retired and s.remaining_pred > 0]
+                 if s is not None and not s.retired
+                 and s.remaining_pred > 0 and not s.cancel_requested]
         if not lanes:
             return False
 
@@ -757,9 +792,10 @@ class InferenceEngine:
                 s.generated.append(tok)
                 if req.first_token_time == 0.0:
                     req.first_token_time = now
+                    self._observe_ttft(now - req.submit_time)
                 s.first_token_time = req.first_token_time
                 self._emit(req, [tok])
-                if self._is_finished(s):
+                if self._is_finished(s) or s.cancel_requested:
                     self._retire(slot_idx)
         else:
             for slot_idx, s, steps_i in call.lanes:
@@ -772,7 +808,8 @@ class InferenceEngine:
                 s.ctx_len += len(new)
                 s.generated.extend(new)
                 self._emit(s.req, new)
-                if self._is_finished(s):
+                if self._is_finished(s) or (s.cancel_requested
+                                            and s.inflight_decode == 0):
                     self._retire(slot_idx)
         # Release deferred frees that no in-flight call references anymore.
         if self._deferred_frees:
@@ -783,6 +820,16 @@ class InferenceEngine:
                 else:
                     still.append((after_id, blocks))
             self._deferred_frees = still
+
+    def _observe_ttft(self, ttft_s: float) -> None:
+        for i, le in enumerate(self.ttft_buckets):
+            if ttft_s <= le:
+                self.ttft_counts[i] += 1
+                break
+        else:
+            self.ttft_counts[-1] += 1
+        self.ttft_sum += ttft_s
+        self.ttft_count += 1
 
     def _is_finished(self, s: _Slot) -> bool:
         return bool(s.generated) and (

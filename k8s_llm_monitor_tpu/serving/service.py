@@ -36,12 +36,19 @@ class RequestHandle:
     the same handle from different threads.
     """
 
-    def __init__(self, request_id: str, eos_id: int):
+    def __init__(self, request_id: str, eos_id: int, cancel_fn=None):
         self.request_id = request_id
         self._eos_id = eos_id
         self._tokens: "queue.Queue[Optional[int]]" = queue.Queue()
         self._done = threading.Event()
         self._result: Optional[GenerationResult] = None
+        self._cancel_fn = cancel_fn
+
+    def cancel(self) -> None:
+        """Ask the engine to stop generating (client went away).  The final
+        result still arrives (finish_reason per whatever completed)."""
+        if self._cancel_fn is not None and not self._done.is_set():
+            self._cancel_fn(self.request_id)
 
     # -- engine side ----------------------------------------------------
 
@@ -93,6 +100,7 @@ class EngineService:
         self.engine = engine
         engine.token_sink = self._sink
         self._submissions: "queue.Queue[GenerationRequest]" = queue.Queue()
+        self._cancels: "queue.Queue[str]" = queue.Queue()
         self._handles: dict[str, RequestHandle] = {}
         self._handles_lock = threading.Lock()
         self._ids = itertools.count()
@@ -115,7 +123,8 @@ class EngineService:
             raise RuntimeError(f"engine service is dead: {self._dead}")
         if request_id is None:
             request_id = f"svc-{next(self._ids)}"
-        handle = RequestHandle(request_id, self.engine.eos_id)
+        handle = RequestHandle(request_id, self.engine.eos_id,
+                               cancel_fn=self._request_cancel)
         with self._handles_lock:
             self._handles[request_id] = handle
         self._submissions.put(GenerationRequest(
@@ -142,6 +151,10 @@ class EngineService:
         tok = self.engine.tokenizer
         return tok.decode(res.token_ids)
 
+    def _request_cancel(self, request_id: str) -> None:
+        self._cancels.put(request_id)
+        self._wake.set()
+
     def stop(self, timeout: float = 10.0) -> None:
         self._stop.set()
         self._wake.set()
@@ -154,7 +167,12 @@ class EngineService:
             try:
                 self.engine.submit(self._submissions.get_nowait())
             except queue.Empty:
-                return
+                break
+        while True:
+            try:
+                self.engine.cancel(self._cancels.get_nowait())
+            except queue.Empty:
+                break
 
     def _run(self) -> None:
         while not self._stop.is_set():

@@ -42,6 +42,10 @@ class TrainConfig:
     # Recompute the per-layer forward during backward (saves activation HBM
     # at ~30% extra FLOPs — the standard long-context trade on TPU).
     remat: bool = False
+    # Explicit ring attention over the mesh's ``seq`` axis (shard_map +
+    # ppermute) instead of GSPMD-derived collectives — O(S/n) activation
+    # memory per device for long sequences.  Needs make_train_step(mesh=...).
+    ring_attention: bool = False
 
 
 @dataclasses.dataclass
@@ -105,10 +109,14 @@ def shard_train_state(state: TrainState, mesh: Mesh) -> TrainState:
 def next_token_loss(
     params: Params, cfg: ModelConfig, tokens: jnp.ndarray,
     loss_mask: jnp.ndarray | None = None,
+    attn_fn=None,
 ) -> jnp.ndarray:
     """Mean next-token cross-entropy over ``tokens`` [B, S] int32."""
-    forward = llama.forward_full
-    logits = forward(params, cfg, tokens[:, :-1])  # [B, S-1, V]
+    # Forward the full sequence and drop the last position's logits (rather
+    # than slicing the input) so S keeps its seq-axis divisibility for the
+    # ring-attention path; the extra position costs 1/S more compute.
+    logits = llama.forward_full(
+        params, cfg, tokens, attn_fn=attn_fn)[:, :-1]  # [B, S-1, V]
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
@@ -119,18 +127,28 @@ def next_token_loss(
 
 
 def make_train_step(
-    cfg: ModelConfig, tc: TrainConfig | None = None
+    cfg: ModelConfig, tc: TrainConfig | None = None, mesh: Mesh | None = None
 ) -> Callable:
     """Build the jitted train step: (params, opt_state, tokens) ->
     (params, opt_state, loss).
 
     Call with sharded inputs; GSPMD propagates the layout through grads and
-    the optimizer update (grad psum over ``data``, TP-local AdamW)."""
+    the optimizer update (grad psum over ``data``, TP-local AdamW).  With
+    ``tc.ring_attention`` and a mesh whose ``seq`` axis is nontrivial, the
+    forward uses explicit ring attention (parallel/ring_attention.py)."""
     tc = tc or TrainConfig()
     opt = make_optimizer(tc)
 
+    attn_fn = None
+    if tc.ring_attention and mesh is not None and mesh.shape["seq"] > 1:
+        from k8s_llm_monitor_tpu.parallel.ring_attention import (
+            make_ring_attention,
+        )
+
+        attn_fn = make_ring_attention(mesh)
+
     def loss_fn(params, tokens):
-        return next_token_loss(params, cfg, tokens)
+        return next_token_loss(params, cfg, tokens, attn_fn=attn_fn)
 
     if tc.remat:
         loss_fn = jax.checkpoint(loss_fn)

@@ -55,6 +55,28 @@ check "NL query" \
   curl -sf -X POST -H 'Content-Type: application/json' \
   -d '{"question":"is the uav fleet healthy?"}' "$BASE/api/v1/query"
 
+echo "== 7. self-observability =="
+check "/metrics exporter" \
+  bash -c "curl -sf $BASE/metrics | grep -q k8s_llm_monitor_build_info"
+
+echo "== 8. mock UAV agent (deployments/uav-configmap.yaml) =="
+# Extract the embedded mock server, boot it locally, and verify it serves
+# the same state shape the pull collector consumes.
+MOCK_DIR="$(mktemp -d)"
+trap 'rm -rf "$MOCK_DIR"; [ -n "${MOCK_PID:-}" ] && kill "$MOCK_PID" 2>/dev/null' EXIT
+python3 - "$MOCK_DIR" <<'PY'
+import sys, yaml
+cm = yaml.safe_load(open("deployments/uav-configmap.yaml"))
+open(sys.argv[1] + "/mock_server.py", "w").write(cm["data"]["mock_server.py"])
+PY
+UAV_ID=uav-mock-ci NODE_NAME=ci-node BATTERY=77 \
+  python3 "$MOCK_DIR/mock_server.py" & MOCK_PID=$!
+sleep 4
+check "mock /health" curl -sf http://127.0.0.1:9090/health
+check "mock state shape" \
+  bash -c "curl -sf http://127.0.0.1:9090/api/v1/state | grep -q remaining_percent"
+kill "$MOCK_PID" 2>/dev/null; MOCK_PID=""
+
 echo
 echo "passed $PASS, failed $FAIL"
 [ "$FAIL" -eq 0 ]

@@ -1,0 +1,126 @@
+"""BERT-family encoder: parity vs transformers' BertModel, mask invariance,
+pooling contracts, and the embedding anomaly detector.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from k8s_llm_monitor_tpu.analysis.anomaly import (
+    EmbeddingAnomalyDetector,
+    HashingTokenizer,
+)
+from k8s_llm_monitor_tpu.models import encoder
+from k8s_llm_monitor_tpu.models.config import EncoderConfig
+
+CFG = EncoderConfig(name="t", vocab_size=120, hidden_size=32,
+                    intermediate_size=64, num_layers=2, num_heads=4,
+                    max_position_embeddings=64)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return encoder.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def test_parity_with_hf_bert():
+    """Convert a randomly-initialized transformers BertModel's weights and
+    check our forward reproduces its last_hidden_state."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=CFG.vocab_size, hidden_size=CFG.hidden_size,
+        num_hidden_layers=CFG.num_layers, num_attention_heads=CFG.num_heads,
+        intermediate_size=CFG.intermediate_size,
+        max_position_embeddings=CFG.max_position_embeddings,
+        hidden_act="gelu", hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0,
+    )
+    model = transformers.BertModel(hf_cfg, add_pooling_layer=False).eval()
+    state = {k: v.detach().numpy() for k, v in model.state_dict().items()}
+    params = encoder.params_from_hf_state(state, CFG)
+
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(1, CFG.vocab_size, size=(3, 12))
+    mask = np.ones((3, 12), np.int64)
+    mask[1, 8:] = 0
+    mask[2, 5:] = 0
+    tokens = tokens * mask  # zero out padding ids like a real tokenizer
+
+    with torch.no_grad():
+        want = model(
+            input_ids=torch.tensor(tokens),
+            attention_mask=torch.tensor(mask),
+        ).last_hidden_state.numpy()
+
+    got = np.asarray(encoder.forward(
+        params, CFG, jnp.asarray(tokens, jnp.int32),
+        jnp.asarray(mask, jnp.int32)))
+    # only valid positions are comparable (padding rows are garbage/masked)
+    m = mask.astype(bool)
+    np.testing.assert_allclose(got[m], want[m], rtol=2e-4, atol=2e-4)
+
+
+def test_mask_invariance(params):
+    """Padding length must not change a sequence's embedding."""
+    ids = [1, 7, 9, 22, 5]
+    t1 = np.zeros((1, 8), np.int32)
+    t1[0, :5] = ids
+    m1 = np.zeros((1, 8), np.int32)
+    m1[0, :5] = 1
+    t2 = np.zeros((1, 16), np.int32)
+    t2[0, :5] = ids
+    t2[0, 10] = 99  # garbage beyond the mask
+    m2 = np.zeros((1, 16), np.int32)
+    m2[0, :5] = 1
+
+    e1 = encoder.encode(params, CFG, jnp.asarray(t1), jnp.asarray(m1))
+    e2 = encoder.encode(params, CFG, jnp.asarray(t2), jnp.asarray(m2))
+    np.testing.assert_allclose(np.asarray(e1), np.asarray(e2),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_encode_pooling_and_norm(params):
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(rng.integers(1, 100, (4, 10)), jnp.int32)
+    mask = jnp.ones((4, 10), jnp.int32)
+    for pooling in ("cls", "mean"):
+        emb = np.asarray(encoder.encode(params, CFG, tokens, mask,
+                                        pooling=pooling))
+        assert emb.shape == (4, CFG.hidden_size)
+        np.testing.assert_allclose(np.linalg.norm(emb, axis=-1), 1.0,
+                                   rtol=1e-5)
+    with pytest.raises(ValueError):
+        encoder.encode(params, CFG, tokens, mask, pooling="max")
+
+
+def test_hashing_tokenizer_deterministic():
+    tok = HashingTokenizer(500)
+    a = tok.encode("Pod failed: OOMKilled in container web", 64)
+    b = tok.encode("Pod failed: OOMKilled in container web", 64)
+    assert a == b
+    assert a[0] == 1 and a[-1] == 2
+    assert all(0 <= t < 500 for t in a)
+
+
+def test_anomaly_detector_flags_planted_outlier():
+    det = EmbeddingAnomalyDetector(CFG)
+    texts = ["BackOff: restarting failed container web"] * 6 + [
+        "NodeHasDiskPressure: node worker-2 status is now NodeHasDiskPressure"
+    ]
+    flagged = det.flag_outliers(texts)
+    assert any(i == 6 for i, _ in flagged), flagged
+    # the repeated texts must not be flagged
+    assert all(i == 6 for i, _ in flagged)
+
+
+def test_anomaly_detector_small_batches_and_empty():
+    det = EmbeddingAnomalyDetector(CFG)
+    assert det.flag_outliers([]) == []
+    assert det.flag_outliers(["a", "b", "c"]) == []
+    assert det.score([]) == []
+    scores = det.score(["same text"] * 5)
+    assert max(scores) < 1e-3  # identical texts sit at the centroid

@@ -129,6 +129,35 @@ def test_uav_source_pull(cluster):
     assert calls and ":9090/api/v1/state" in calls[0]
 
 
+def test_uav_source_send_command(cluster):
+    """Command push to a node's agent (ref SendCommandToUAV — whose body
+    marshaling was an unfinished TODO; ours must actually send params)."""
+    fake, client = cluster
+    fake.add_pod(
+        "uav-agent-cmd",
+        node="n2",
+        labels={"app": "uav-agent"},
+        image="uav-agent:dev",
+    )
+    posts = []
+
+    def poster(url, payload):
+        posts.append((url, payload))
+        return {"status": "armed"}
+
+    src = UAVMetricsSource(client, "default", poster=poster)
+    res = src.send_command("n2", "takeoff", {"altitude": 30})
+    assert res == {"status": "armed"}
+    url, payload = posts[0]
+    assert url.endswith(":9090/api/v1/command/takeoff")
+    assert payload == {"altitude": 30}
+
+    import pytest as _pytest
+
+    with _pytest.raises(ValueError):
+        src.send_command("missing-node", "arm")
+
+
 def test_manager_collect_and_rollup(cluster):
     fake, client = cluster
     mgr = Manager(client, MetricsConfig(namespaces=["default"], enable_network=True))

@@ -106,6 +106,21 @@ def test_stream_yields_tokens(params):
     svc.stop()
 
 
+def test_cancel_stops_generation(params):
+    """Cancelling a handle mid-stream retires the request early instead of
+    decoding to max_tokens for a dead client."""
+    eng = InferenceEngine(CFG, params, EngineConfig(**ECFG), eos_id=-1)
+    svc = EngineService(eng)
+    handle = svc.submit([5, 6, 7], SamplingParams(max_tokens=400))
+    stream = handle.stream(timeout=120)
+    got = [next(stream), next(stream)]
+    handle.cancel()
+    res = handle.result(timeout=120)
+    assert len(got) == 2
+    assert len(res.token_ids) < 400, "cancel did not stop generation"
+    svc.stop()
+
+
 def test_eos_not_streamed(params):
     eng = InferenceEngine(CFG, params, EngineConfig(**ECFG), eos_id=-1)
     svc = EngineService(eng)
