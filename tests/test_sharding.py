@@ -68,3 +68,65 @@ def test_tp_engine_generation_matches_unsharded(cpu_mesh_devices):
     tp = InferenceEngine(CFG, params, ecfg, eos_id=-1, mesh=mesh).generate(prompts, sp)
     for a, b in zip(plain, tp):
         assert a.token_ids == b.token_ids
+
+
+def test_70b_class_specs_divide_on_tp8_and_tp16():
+    """BASELINE config #5 (70B-class GSPMD TP): every parameter's sharded
+    axis must divide evenly on TP-8 and TP-16 meshes, and the KV pages fall
+    back to replication when TP exceeds the 8 KV heads — checked via
+    eval_shape so no 70B weights are materialized."""
+    from k8s_llm_monitor_tpu.models.config import PRESETS
+    from k8s_llm_monitor_tpu.parallel.sharding import kv_pages_partition_specs
+
+    class _FakeMesh:
+        def __init__(self, tp):
+            self.shape = {"data": 1, "seq": 1, "model": tp}
+
+    for name in ("llama3-70b", "qwen2-72b"):
+        cfg = PRESETS[name]
+        shapes = jax.eval_shape(
+            lambda rng, c=cfg: llama.init_params(rng, c),
+            jax.random.PRNGKey(0))
+        specs = param_partition_specs(shapes)
+
+        for tp in (8, 16):
+            def check(path, leaf, spec):
+                for dim, axis in enumerate(spec):
+                    if axis == "model":
+                        assert leaf.shape[dim] % tp == 0, (
+                            f"{name} tp={tp}: {path} {leaf.shape} "
+                            f"axis {dim} not divisible")
+
+            jax.tree_util.tree_map_with_path(
+                lambda p, l, s: check(p, l, s), shapes, specs)
+
+        pages_shape = jax.eval_shape(
+            lambda c=cfg: llama.init_kv_pages(c, 16, 16))
+        kv8 = kv_pages_partition_specs(pages_shape, _FakeMesh(8))
+        assert kv8.k[0] == P(None, None, "model", None)  # 8 kv heads / tp8
+        kv16 = kv_pages_partition_specs(pages_shape, _FakeMesh(16))
+        assert kv16.k[0] == P(None, None, None, None)    # tp16 > kv -> repl
+
+
+def test_70b_dims_tp_forward_lowers(cpu_mesh_devices):
+    """A 70B-dimensioned (2-layer) model must lower with the TP specs on the
+    8-device mesh — catches partitioner rejections (uneven shards, bad
+    specs) without allocating 70B weights."""
+    from k8s_llm_monitor_tpu.models.config import LLAMA3_70B
+    import dataclasses as _dc
+
+    cfg = _dc.replace(LLAMA3_70B, num_layers=2)
+    mesh = create_mesh(MeshConfig(model=8))
+    shapes = jax.eval_shape(
+        lambda rng: llama.init_params(rng, cfg), jax.random.PRNGKey(0))
+    specs = param_partition_specs(shapes)
+    shaped = jax.tree.map(
+        lambda l, s: jax.ShapeDtypeStruct(
+            l.shape, l.dtype, sharding=NamedSharding(mesh, s)),
+        shapes, specs)
+    tok_shape = jax.ShapeDtypeStruct(
+        (1, 64), jnp.int32, sharding=NamedSharding(mesh, P(None, None)))
+    lowered = jax.jit(
+        lambda p, t: llama.forward_full(p, cfg, t)
+    ).lower(shaped, tok_shape)
+    assert "stablehlo" in lowered.as_text()[:4000].lower()
