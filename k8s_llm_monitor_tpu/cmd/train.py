@@ -79,9 +79,19 @@ def main(argv: list[str] | None = None) -> int:
     tc = TrainConfig(learning_rate=args.lr, remat=args.remat,
                      ring_attention=args.ring_attention)
     state = create_train_state(jax.random.PRNGKey(0), cfg, tc)
+    step0 = 0
     if args.resume:
-        state.params = restore_checkpoint(args.resume, like=state.params)
-        log.info("resumed params from %s", args.resume)
+        # Full train state: params + AdamW moments + step, so resumption
+        # continues the run instead of restarting the optimizer.
+        restored = restore_checkpoint(
+            args.resume,
+            like={"params": state.params, "opt_state": state.opt_state,
+                  "step": 0},
+        )
+        state.params = restored["params"]
+        state.opt_state = restored["opt_state"]
+        step0 = int(restored["step"])
+        log.info("resumed from %s at step %d", args.resume, step0)
     state = shard_train_state(state, mesh)
     step_fn = make_train_step(cfg, tc, mesh=mesh)
 
@@ -113,18 +123,19 @@ def main(argv: list[str] | None = None) -> int:
     params, opt_state = state.params, state.opt_state
     t0 = time.monotonic()
     tokens_seen = 0
-    for step in range(1, args.steps + 1):
+    last = step0 + args.steps
+    for step in range(step0 + 1, last + 1):
         params, opt_state, loss = step_fn(params, opt_state, next_batch())
         tokens_seen += B * S
-        if step % args.log_every == 0 or step == args.steps:
+        if step % args.log_every == 0 or step == last:
             loss = float(loss)
             dt = time.monotonic() - t0
             log.info("step %d/%d loss %.4f | %.0f tok/s",
-                     step, args.steps, loss, tokens_seen / max(dt, 1e-9))
-        if args.ckpt_dir and (step % args.ckpt_every == 0
-                              or step == args.steps):
+                     step, last, loss, tokens_seen / max(dt, 1e-9))
+        if args.ckpt_dir and (step % args.ckpt_every == 0 or step == last):
             path = f"{args.ckpt_dir}/step_{step}"
-            save_checkpoint(path, jax.device_get(params))
+            save_checkpoint(path, jax.device_get(
+                {"params": params, "opt_state": opt_state, "step": step}))
             log.info("checkpoint saved: %s", path)
     return 0
 

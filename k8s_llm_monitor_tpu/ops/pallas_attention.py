@@ -3,17 +3,25 @@
 The XLA fallback (ops/attention.py:paged_decode_attention) materializes every
 sequence's pages into a contiguous ``[B, max_blocks*bs, KVH, D]`` gather per
 layer per step — O(B * max_ctx) HBM traffic regardless of actual context
-lengths.  This kernel instead streams exactly the pages named in the block
-table through VMEM with online (flash-style) softmax accumulation:
+lengths.  This kernel instead streams exactly the pages a sequence actually
+uses through VMEM with online (flash-style) softmax accumulation:
 
-  * grid = (batch, max_blocks_per_seq); the block-table entry for grid cell
-    (b, j) drives the k/v page BlockSpec index map (scalar-prefetched, so the
-    DMA for page j+1 is issued while page j computes — Pallas double-buffers
-    revisited specs automatically).
-  * pages past a sequence's length map to the null block 0 and are skipped
-    with ``pl.when`` (consecutive identical indices skip the re-copy).
-  * GQA: each kv head's page slice serves its ``H // KVH`` query heads; the
-    online-softmax state (m, l, acc) lives in VMEM scratch across grid steps.
+  * grid = (batch,): one program per sequence.  K/V page arrays stay in HBM
+    (``memory_space=ANY``); the program walks its block table with a
+    double-buffered ``make_async_copy`` loop bounded by the sequence's real
+    page count (``cdiv(length, bs)``), so unused table slots cost nothing —
+    a fine (batch x max_blocks) grid spends more time on per-program
+    overhead than on the 16-32 KB of page data each program touches.
+  * the DMA for page j+1 is started before page j's math, hiding HBM
+    latency behind the compute.
+  * GQA without any in-kernel head splitting: pages are DMA'd as
+    ``[bs, KVH*D]`` rows (the fused lane dim keeps HBM slices 128-aligned
+    for D < 128), queries enter **block-diagonal** — q head h occupies its
+    kv-group's D-slice of a ``[H, KVH*D]`` matrix and zeros elsewhere — so
+    ``scores = q_bd @ page.T`` and ``acc += p @ page`` are single MXU dots
+    whose cross-head terms vanish; the per-head output slice is extracted
+    by XLA after the kernel.  The online-softmax state (m, l, acc) is a
+    ``fori_loop`` carry.
 
 Selected by ops/attention.py:select_attn_impl on TPU (single-chip engine);
 CPU tests run it in interpreter mode for parity with the XLA reference.
@@ -38,70 +46,88 @@ def _decode_kernel(
     # scalar prefetch
     tables_ref,            # [B, NB] int32 block ids
     lens_ref,              # [B] int32 valid kv length per sequence
-    # blocks
-    q_ref,                 # [1, H, D]
-    k_ref,                 # [1, bs, KVH, D] — page tables_ref[b, j]
-    v_ref,                 # [1, bs, KVH, D]
+    # inputs
+    q_ref,                 # [1, H, KVH*D] block-diagonal queries (VMEM)
+    k_hbm,                 # [num_blocks, bs, KVH*D] (ANY/HBM, whole array)
+    v_hbm,                 # same
     # out
-    o_ref,                 # [1, H, D]
-    # scratch (persists across the j grid dimension)
-    m_ref,                 # [H, 128] f32 running max
-    l_ref,                 # [H, 128] f32 running denominator
-    acc_ref,               # [H, D] f32 running numerator
-    *,
-    kv_heads: int,
-    q_per_kv: int,
+    o_ref,                 # [1, H, KVH*D]
 ):
     b = pl.program_id(0)
-    j = pl.program_id(1)
-    bs = k_ref.shape[1]
-    D = q_ref.shape[2]
-
-    @pl.when(j == 0)
-    def _init():
-        m_ref[:] = jnp.full_like(m_ref, NEG_INF)
-        l_ref[:] = jnp.zeros_like(l_ref)
-        acc_ref[:] = jnp.zeros_like(acc_ref)
-
+    bs = k_hbm.shape[1]
+    H = q_ref.shape[1]
+    F = q_ref.shape[2]                                     # KVH * D
     length = lens_ref[b]
-    start = j * bs
+    n_blocks = (length + bs - 1) // bs                     # >= 1 (length >= 1)
 
-    @pl.when(start < length)
-    def _block():
-        scale = D ** -0.5
-        # Positions covered by this page, masked against the true length.
-        pos = start + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
-        valid = pos < length                                   # [1, bs]
-        for h in range(kv_heads):
-            sl = slice(h * q_per_kv, (h + 1) * q_per_kv)
-            qh = q_ref[0, sl, :].astype(jnp.float32) * scale   # [qpk, D]
-            kh = k_ref[0, :, h, :].astype(jnp.float32)         # [bs, D]
+    def scoped(k_buf, v_buf, sem):
+        # k_buf/v_buf: [2, bs, KVH*D] double buffers; sem: [2, 2] DMA sems.
+        def start_copy(slot, j):
+            blk = tables_ref[b, j]
+            pltpu.make_async_copy(
+                k_hbm.at[blk], k_buf.at[slot], sem.at[slot, 0]).start()
+            pltpu.make_async_copy(
+                v_hbm.at[blk], v_buf.at[slot], sem.at[slot, 1]).start()
+
+        def wait_copy(slot, j):
+            blk = tables_ref[b, j]
+            pltpu.make_async_copy(
+                k_hbm.at[blk], k_buf.at[slot], sem.at[slot, 0]).wait()
+            pltpu.make_async_copy(
+                v_hbm.at[blk], v_buf.at[slot], sem.at[slot, 1]).wait()
+
+        start_copy(0, 0)
+        q = q_ref[0].astype(jnp.float32)                   # [H, F] block-diag
+
+        def body(j, carry):
+            m, l, acc = carry                  # [H, 1], [H, 1], [H, F] (f32)
+            slot = jax.lax.rem(j, 2)
+
+            @pl.when(j + 1 < n_blocks)
+            def _prefetch():
+                start_copy(1 - slot, j + 1)
+
+            wait_copy(slot, j)
+            pos = j * bs + jax.lax.broadcasted_iota(jnp.int32, (1, bs), 1)
+            valid = pos < length                            # [1, bs]
+            kblk = k_buf[slot].astype(jnp.float32)          # [bs, F]
+            vblk = v_buf[slot].astype(jnp.float32)
+
+            # Block-diagonal q makes this one dot per page: head h only
+            # overlaps its own kv group's D-slice, so cross-head products
+            # are zero.
             s = jax.lax.dot_general(
-                qh, kh, (((1,), (1,)), ((), ())),
+                q, kblk, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            )                                                   # [qpk, bs]
+            )                                               # [H, bs]
             s = jnp.where(valid, s, NEG_INF)
 
-            m_prev = m_ref[sl, :]                               # [qpk, 128]
-            l_prev = l_ref[sl, :]
-            m_cur = jnp.max(s, axis=-1, keepdims=True)          # [qpk, 1]
-            m_new = jnp.maximum(m_prev, m_cur)                  # [qpk, 128]
-            alpha = jnp.exp(m_prev - m_new)
-            p = jnp.exp(s - m_new[:, :1])                       # [qpk, bs]
-            l_ref[sl, :] = alpha * l_prev + jnp.sum(
-                p, axis=-1, keepdims=True)
-            m_ref[sl, :] = m_new
-
-            vh = v_ref[0, :, h, :].astype(jnp.float32)          # [bs, D]
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m, m_cur)
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new)                          # [H, bs]
+            l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
             pv = jax.lax.dot_general(
-                p, vh, (((1,), (0,)), ((), ())),
+                p, vblk, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32,
-            )                                                   # [qpk, D]
-            acc_ref[sl, :] = alpha[:, :D] * acc_ref[sl, :] + pv
+            )                                               # [H, F]
+            return m_new, l_new, alpha * acc + pv
 
-    @pl.when(j == pl.num_programs(1) - 1)
-    def _finish():
-        o_ref[0] = (acc_ref[:] / l_ref[:, :D]).astype(o_ref.dtype)
+        m0 = jnp.full((H, 1), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((H, 1), jnp.float32)
+        acc0 = jnp.zeros((H, F), jnp.float32)
+        _, l, acc = jax.lax.fori_loop(0, n_blocks, body, (m0, l0, acc0))
+        # acc rows carry the head's output in its kv-group slice (plus
+        # group-mates' contributions in other slices, sliced away by the
+        # caller).
+        o_ref[0] = (acc / l).astype(o_ref.dtype)
+
+    pl.run_scoped(
+        scoped,
+        k_buf=pltpu.VMEM((2, bs, F), k_hbm.dtype),
+        v_buf=pltpu.VMEM((2, bs, F), v_hbm.dtype),
+        sem=pltpu.SemaphoreType.DMA((2, 2)),
+    )
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -130,43 +156,44 @@ def paged_decode_attention_pallas(
     """
     B, S, H, D = q.shape
     assert S == 1, f"decode kernel expects one query token, got {S}"
-    _, bs, KVH, Dk = k_pages.shape
+    nblk, bs, KVH, Dk = k_pages.shape
     assert D == Dk and D <= 128, (D, Dk)
-    NB = block_table.shape[1]
     q_per_kv = H // KVH
+    F = KVH * D
 
-    kernel = functools.partial(
-        _decode_kernel, kv_heads=KVH, q_per_kv=q_per_kv)
+    # Block-diagonal queries (scaled): head h lives in its kv group's
+    # D-slice of the F lane dim, zeros elsewhere — see _decode_kernel.
+    group = jnp.arange(H, dtype=jnp.int32) // q_per_kv            # [H]
+    onehot = jax.nn.one_hot(group, KVH, dtype=q.dtype)            # [H, KVH]
+    q_bd = (q[:, 0, :, None, :] * (D ** -0.5)
+            * onehot[None, :, :, None]).reshape(B, H, F)
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B, NB),
+        grid=(B,),
         in_specs=[
-            pl.BlockSpec((1, H, D), lambda b, j, tbl, lens: (b, 0, 0)),
-            pl.BlockSpec(
-                (1, bs, KVH, D),
-                lambda b, j, tbl, lens: (tbl[b, j], 0, 0, 0),
-            ),
-            pl.BlockSpec(
-                (1, bs, KVH, D),
-                lambda b, j, tbl, lens: (tbl[b, j], 0, 0, 0),
-            ),
+            pl.BlockSpec((1, H, F), lambda b, tbl, lens: (b, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),   # K pages stay in HBM
+            pl.BlockSpec(memory_space=pl.ANY),   # V pages stay in HBM
         ],
-        out_specs=pl.BlockSpec((1, H, D), lambda b, j, tbl, lens: (b, 0, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((H, 128), jnp.float32),
-            pltpu.VMEM((H, 128), jnp.float32),
-            pltpu.VMEM((H, D), jnp.float32),
-        ],
+        out_specs=pl.BlockSpec((1, H, F), lambda b, tbl, lens: (b, 0, 0)),
     )
 
-    out = pl.pallas_call(
-        kernel,
+    out_full = pl.pallas_call(
+        _decode_kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((B, H, F), q.dtype),
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "arbitrary"),
+            # Programs touch disjoint q/o rows and only read pages: the
+            # batch grid is safely parallel (megacore splits it).
+            dimension_semantics=("parallel",),
         ),
         interpret=interpret,
-    )(block_table, lengths, q[:, 0], k_pages, v_pages)
+    )(block_table, lengths, q_bd,
+      k_pages.reshape(nblk, bs, F), v_pages.reshape(nblk, bs, F))
+
+    # Extract each head's own kv-group slice.
+    out = jnp.take_along_axis(
+        out_full.reshape(B, H, KVH, D),
+        group[None, :, None, None], axis=2)[:, :, 0, :]
     return out[:, None]
