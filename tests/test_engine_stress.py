@@ -1,0 +1,155 @@
+"""Randomized stress for the async engine pipeline: mixed lengths, EOS,
+preemption under a tiny KV pool, and cancellation must never deadlock, leak
+blocks, or drop results.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from k8s_llm_monitor_tpu.models import llama
+from k8s_llm_monitor_tpu.models.config import ModelConfig
+from k8s_llm_monitor_tpu.serving.engine import (
+    EngineConfig,
+    GenerationRequest,
+    InferenceEngine,
+    SamplingParams,
+)
+
+CFG = ModelConfig(name="t", vocab_size=300, hidden_size=32, intermediate_size=64,
+                  num_layers=2, num_heads=4, num_kv_heads=2, dtype="float32",
+                  rope_theta=10_000.0)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def test_stress_mixed_workload_under_pressure(params):
+    """40 requests with random prompts/budgets through a pool small enough
+    to force preemptions, with EOS active and cancels injected mid-flight."""
+    eng = InferenceEngine(
+        CFG, params,
+        EngineConfig(max_slots=4, num_blocks=40, block_size=4,
+                     max_blocks_per_seq=24, prefill_buckets=(16, 32),
+                     max_prefills_per_step=4, max_admission_rounds=2,
+                     decode_steps_per_iter=4, max_inflight=2),
+        eos_id=7,  # a plausible token: some generations stop early
+    )
+    rng = np.random.default_rng(0)
+    N = 40
+    budgets = {}
+    for i in range(N):
+        L = int(rng.integers(3, 60))          # some prompts need chunking
+        mt = int(rng.integers(1, 30))
+        budgets[f"s{i}"] = mt
+        eng.submit(GenerationRequest(
+            request_id=f"s{i}",
+            prompt_ids=list(rng.integers(8, 300, size=L)),  # avoid eos id
+            sampling=SamplingParams(max_tokens=mt),
+        ))
+
+    cancelled = set()
+    steps = 0
+    while eng.has_work:
+        eng.step()
+        steps += 1
+        if steps == 5:
+            for rid in ("s3", "s17", "s30"):
+                if eng.cancel(rid):
+                    cancelled.add(rid)
+        assert steps < 10_000, "engine failed to drain (livelock)"
+
+    results = {f"s{i}": eng.poll(f"s{i}") for i in range(N)}
+    for rid, r in results.items():
+        assert r is not None, f"{rid}: no result delivered"
+        if r.finish_reason == "error":
+            assert rid in cancelled, f"{rid} errored: {r.error}"
+            continue
+        assert r.finish_reason in ("eos", "length")
+        assert len(r.token_ids) <= budgets[rid] + 1
+        if r.finish_reason == "length" and rid not in cancelled:
+            assert len(r.token_ids) == budgets[rid]
+        assert all(t != 7 for t in r.token_ids), "eos token leaked into output"
+
+    # No leaked KV blocks: everything returned to the pool.
+    assert eng.allocator.free_blocks == 40 - 1  # block 0 reserved
+    assert not eng._deferred_frees
+    assert all(s is None for s in eng._slots)
+    assert not eng._inflight
+
+
+def test_stress_cancel_storm(params):
+    """Cancel every request at staggered points; pool must fully recover and
+    the engine must stay usable afterwards."""
+    eng = InferenceEngine(
+        CFG, params,
+        EngineConfig(max_slots=4, num_blocks=64, block_size=8,
+                     max_blocks_per_seq=16, prefill_buckets=(16,),
+                     decode_steps_per_iter=4, max_inflight=2),
+        eos_id=-1,
+    )
+    rng = np.random.default_rng(1)
+    N = 12
+    for i in range(N):
+        eng.submit(GenerationRequest(
+            f"c{i}", list(rng.integers(3, 300, size=6)),
+            SamplingParams(max_tokens=50)))
+
+    steps = 0
+    while eng.has_work:
+        eng.step()
+        steps += 1
+        if steps % 2 == 0:
+            eng.cancel(f"c{steps % N}")
+        if steps == 4:
+            for i in range(N):
+                eng.cancel(f"c{i}")
+        assert steps < 5_000
+
+    for i in range(N):
+        r = eng.poll(f"c{i}")
+        assert r is not None
+    assert eng.allocator.free_blocks == 64 - 1
+
+    # Engine still serves correctly after the storm.
+    [r] = eng.generate([[5, 6, 7, 8]], SamplingParams(max_tokens=5))
+    assert r.finish_reason == "length" and len(r.token_ids) == 5
+
+
+def test_stress_waves_of_submissions(params):
+    """Interleave submission waves with stepping so admission, retirement,
+    and slot reuse all overlap in-flight decode calls."""
+    eng = InferenceEngine(
+        CFG, params,
+        EngineConfig(max_slots=3, num_blocks=48, block_size=4,
+                     max_blocks_per_seq=12, prefill_buckets=(16,),
+                     max_prefills_per_step=2, decode_steps_per_iter=2,
+                     max_inflight=2),
+        eos_id=-1,
+    )
+    rng = np.random.default_rng(2)
+    ids = []
+    steps = 0
+    for wave in range(6):
+        for j in range(4):
+            rid = f"w{wave}-{j}"
+            ids.append(rid)
+            eng.submit(GenerationRequest(
+                rid, list(rng.integers(3, 300, size=int(rng.integers(2, 12)))),
+                SamplingParams(max_tokens=int(rng.integers(1, 10)))))
+        for _ in range(3):
+            if eng.has_work:
+                eng.step()
+                steps += 1
+    while eng.has_work:
+        eng.step()
+        steps += 1
+        assert steps < 5_000
+
+    for rid in ids:
+        r = eng.poll(rid)
+        assert r is not None and r.finish_reason == "length"
+    assert eng.allocator.free_blocks == 48 - 1
