@@ -2,8 +2,8 @@
 """Single-chip serving benchmark — the north-star SLO tracker.
 
 Measures p50 TTFT for a burst of concurrent diagnosis-sized queries through
-the continuous-batching engine (BASELINE.md config #4, scaled to the one
-available chip), plus decode throughput, and prints ONE JSON line:
+the continuous-batching engine, decode throughput, and achieved MXU / HBM
+utilization, and prints ONE JSON line:
 
     {"metric": "p50_ttft_100c_ms", "value": <ms>, "unit": "ms",
      "vs_baseline": <500ms / p50>, ...}
@@ -12,31 +12,96 @@ available chip), plus decode throughput, and prints ONE JSON line:
 BASELINE.md / BASELINE.json north_star) since the reference publishes no
 benchmark numbers of its own (verified in SURVEY.md §6): > 1.0 beats the SLO.
 
-Model: LLAMA_1B preset (models/config.py) with random-init bf16 weights —
-the per-chip arithmetic matches the 8B-on-v5e-8 target within a small factor
-and leaves HBM headroom for the KV pool on a 16 GB chip.
+Model: **Llama-3-8B geometry with int8 weight-only quantization**
+(utils/quantize.py) — the real BASELINE.md config #2/#4 target, which bf16
+cannot fit on the 16 GB chip.  Weights are random-init (generated directly
+in int8; the bf16 intermediate would not fit either) — the arithmetic,
+shapes, and HBM traffic match the real checkpoint exactly.  Honest context:
+the 500 ms SLO is defined for v5e-8 (8 chips, BASELINE.md config #4); this
+bench drives ONE chip with the full 100-request burst, i.e. 8x the SLO's
+per-chip load.  The per-chip-equivalent leg (100/8 -> 12 concurrent) is
+reported in extras as the apples-to-apples number.
+
+A persistent XLA compilation cache (.jax_cache/) makes warm boots cheap;
+the bench reports its warmup time and whether the cache was already
+populated.
 
 Run: ``python bench.py`` (uses the default JAX platform — the real TPU under
-the driver; set BENCH_CONCURRENCY / BENCH_MODEL / JAX_PLATFORMS=cpu to
-shrink for local smoke runs).
+the driver; set BENCH_MODEL=llama-1b BENCH_CONCURRENCY=8 JAX_PLATFORMS=cpu
+to shrink for local smoke runs).
 """
 
 from __future__ import annotations
 
 import json
 import os
+import pathlib
 import sys
 import time
+
+CACHE_DIR = pathlib.Path(__file__).parent / ".jax_cache"
 
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+# Approximate chip peaks for utilization reporting, keyed by substrings of
+# jax Device.device_kind.  (bf16 matmul TFLOP/s, HBM GB/s.)
+CHIP_PEAKS = {
+    "v5 lite": (197e12, 819e9),     # v5e
+    "v5e": (197e12, 819e9),
+    "v5p": (459e12, 2765e9),
+    "v4": (275e12, 1228e9),
+    "v6": (918e12, 1640e9),         # v6e (Trillium)
+}
+
+
+def chip_peaks(device_kind: str) -> tuple[float, float]:
+    kind = device_kind.lower()
+    for key, peaks in CHIP_PEAKS.items():
+        if key in kind:
+            return peaks
+    return (0.0, 0.0)
+
+
+def weight_accounting(params, tied: bool) -> tuple[int, int]:
+    """(matmul weight elements, streamed weight bytes per decode step).
+
+    The untied embedding table is a pure gather — zero matmul FLOPs and
+    only B rows of traffic per step — so it is excluded from both unless
+    the model ties it to the unembed matmul.
+    """
+    import jax
+
+    elems = 0
+    stream_bytes = 0
+    for path, leaf in jax.tree_util.tree_leaves_with_path(params):
+        keys = [p.key for p in path if hasattr(p, "key")]
+        if not keys:
+            continue
+        is_embed = "embed" in keys
+        if keys[-1] in ("kernel", "kernel_q", "weight", "weight_q"):
+            if is_embed and not tied:
+                continue
+            elems += leaf.size
+            stream_bytes += leaf.size * leaf.dtype.itemsize
+    return elems, stream_bytes
+
+
 def main() -> None:
     t0 = time.monotonic()
+    cache_was_warm = CACHE_DIR.is_dir() and any(CACHE_DIR.iterdir())
     import numpy as np
     import jax
+
+    if os.environ.get("JAX_PLATFORMS"):
+        # The environment's sitecustomize re-pins jax_platforms to the real
+        # chip; honor an explicit JAX_PLATFORMS (CPU smoke runs) over it.
+        jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+    jax.config.update("jax_compilation_cache_dir", str(CACHE_DIR))
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 
     from k8s_llm_monitor_tpu.models import llama
     from k8s_llm_monitor_tpu.models.config import PRESETS
@@ -46,25 +111,41 @@ def main() -> None:
         InferenceEngine,
         SamplingParams,
     )
+    from k8s_llm_monitor_tpu.utils import quantize as qz
 
-    model_name = os.environ.get("BENCH_MODEL", "llama-1b")
+    model_name = os.environ.get("BENCH_MODEL", "llama3-8b")
+    quant = os.environ.get("BENCH_QUANT", "int8")
     n_requests = int(os.environ.get("BENCH_CONCURRENCY", "100"))
     prompt_len = int(os.environ.get("BENCH_PROMPT_LEN", "192"))
     max_tokens = int(os.environ.get("BENCH_MAX_TOKENS", "48"))
 
     cfg = PRESETS[model_name]
     dev = jax.devices()[0]
-    log(f"bench: {model_name} on {dev.platform}:{dev.device_kind} "
+    flops_peak, hbm_peak = chip_peaks(dev.device_kind)
+    log(f"bench: {model_name} ({quant}) on {dev.platform}:{dev.device_kind} "
         f"({n_requests} concurrent, prompt {prompt_len}, gen {max_tokens})")
 
-    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    if quant == "int8":
+        params = qz.init_params_quantized(jax.random.PRNGKey(0), cfg)
+    else:
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    weight_elems, stream_bytes = weight_accounting(params, cfg.tie_embeddings)
+    weight_bytes = qz.param_bytes(params)
+    log(f"weights: {weight_elems/1e9:.2f}B matmul params, "
+        f"{weight_bytes/2**30:.2f} GiB on device")
+
+    # Prompt bucket hugs the prompt length (rounded to the 64-lane sublane
+    # multiple; 192 itself is 1.5 * 128 and MXU-friendly): minimal padding
+    # waste in the prefill calls that dominate TTFT.
+    bucket = int(np.ceil(prompt_len / 64) * 64)
+    seq_cap = prompt_len + max_tokens + 1
     ecfg = EngineConfig(
         max_slots=int(os.environ.get("BENCH_SLOTS", "128")),
-        num_blocks=4096,
+        num_blocks=int(os.environ.get("BENCH_BLOCKS", "2200")),
         block_size=16,
-        max_blocks_per_seq=32,
-        prefill_buckets=(256,),
-        max_prefills_per_step=int(os.environ.get("BENCH_PREFILL_BATCH", "32")),
+        max_blocks_per_seq=(seq_cap + 15) // 16,
+        prefill_buckets=(max(bucket, prompt_len),),
+        max_prefills_per_step=int(os.environ.get("BENCH_PREFILL_BATCH", "16")),
         max_admission_rounds=8,
         decode_steps_per_iter=int(os.environ.get("BENCH_DECODE_STEPS", "8")),
     )
@@ -77,15 +158,18 @@ def main() -> None:
 
     # Warm up every compiled shape — batched (P=max_prefills_per_step) and
     # single (P=1) prefill, and the fused-decode K ladder the drain will
-    # walk — so the measured run excludes compile time.
-    log("warmup (compiles prefill/decode)...")
+    # walk — so the measured run excludes compile time.  With a populated
+    # .jax_cache this is seconds, not minutes.
+    log(f"warmup (compiles prefill/decode; cache "
+        f"{'warm' if cache_was_warm else 'cold'})...")
     wt0 = time.monotonic()
     eng.generate([prompt() for _ in range(2)],
                  SamplingParams(max_tokens=max_tokens))
     eng.generate([prompt()], SamplingParams(max_tokens=4))
-    log(f"warmup done in {time.monotonic() - wt0:.1f}s")
+    warmup_s = time.monotonic() - wt0
+    log(f"warmup done in {warmup_s:.1f}s")
 
-    # --- concurrent burst: all requests queued at t=0, engine drains ---
+    # --- headline: concurrent burst, all requests queued at t=0 ---------
     bt0 = time.monotonic()
     for i in range(n_requests):
         eng.submit(GenerationRequest(
@@ -102,7 +186,6 @@ def main() -> None:
     assert all(r is not None and r.finish_reason != "error" for r in results)
     steps_run, prefills_run = eng.steps - steps0, eng.prefills - prefills0
     preempts = eng.preemptions
-    del eng  # free the headline KV pool before the long-prompt engine
     ttfts = np.array(sorted(r.ttft_s for r in results))
     total_tokens = sum(len(r.token_ids) for r in results)
     p50 = float(np.percentile(ttfts, 50))
@@ -113,7 +196,90 @@ def main() -> None:
         f"({steps_run} steps, {prefills_run} prefills, "
         f"{preempts} preemptions)")
     log(f"p50 TTFT {p50 * 1e3:.1f} ms | p99 {p99 * 1e3:.1f} ms | "
-        f"throughput {toks_per_s:.0f} tok/s | total {time.monotonic()-t0:.0f}s")
+        f"throughput {toks_per_s:.0f} tok/s")
+
+    # --- per-chip-equivalent leg: the SLO's v5e-8 config spread over 8
+    # chips is ~12 concurrent per chip; same engine, warm shapes. ---------
+    perchip_p50_ms = None
+    try:
+        n_pc = max(1, n_requests // 8)
+        for i in range(n_pc):
+            eng.submit(GenerationRequest(
+                request_id=f"pc-{i}", prompt_ids=prompt(),
+                sampling=SamplingParams(max_tokens=max_tokens)))
+        while eng.has_work:
+            eng.step()
+        pcres = [eng.poll(f"pc-{i}") for i in range(n_pc)]
+        assert all(r is not None and r.finish_reason != "error" for r in pcres)
+        perchip_p50_ms = float(np.percentile(
+            np.array(sorted(r.ttft_s for r in pcres)), 50)) * 1e3
+        log(f"per-chip-equivalent ({n_pc} concurrent): "
+            f"p50 TTFT {perchip_p50_ms:.1f} ms")
+    except Exception as exc:  # noqa: BLE001 — extras never fail the bench
+        log(f"per-chip leg skipped: {exc}")
+
+    # --- utilization micro-legs on the warm compiled programs -----------
+    prefill_tflops = prefill_mfu = 0.0
+    decode_gbs = decode_bw_util = 0.0
+    try:
+        import jax.numpy as jnp
+
+        P = ecfg.max_prefills_per_step
+        S = ecfg.prefill_buckets[-1]
+        toks = jnp.asarray(rng.integers(4, cfg.vocab_size - 4,
+                                        size=(P, S)), jnp.int32)
+        lengths = jnp.full((P,), S, jnp.int32)
+        blocks_per = min((S + 15) // 16, ecfg.max_blocks_per_seq)
+        tbl = np.zeros((P, ecfg.max_blocks_per_seq), np.int32)
+        for j in range(P):
+            lo = 1 + j * blocks_per
+            tbl[j, :blocks_per] = np.arange(lo, lo + blocks_per)
+        tbl = jnp.asarray(tbl)
+        # Warm (already compiled by the engine) — time reps.
+        first, eng.pages = eng._prefill_greedy(
+            params, toks, lengths, eng.pages, tbl)
+        first.block_until_ready()
+        reps = 3
+        pt0 = time.monotonic()
+        for _ in range(reps):
+            first, eng.pages = eng._prefill_greedy(
+                params, toks, lengths, eng.pages, tbl)
+        first.block_until_ready()
+        pdt = time.monotonic() - pt0
+        # Dense-matmul FLOPs dominate; attention terms are <2% at S=192.
+        prefill_tflops = reps * 2.0 * weight_elems * P * S / pdt / 1e12
+        if flops_peak:
+            prefill_mfu = prefill_tflops * 1e12 / flops_peak
+        log(f"prefill: {prefill_tflops:.1f} TFLOP/s"
+            + (f" ({prefill_mfu * 100:.0f}% MFU)" if flops_peak else ""))
+
+        # Decode: each fused step streams the full weight set once.
+        K = ecfg.decode_steps_per_iter
+        prog = eng._decode_program(K, sampled=False)
+        B = ecfg.max_slots
+        ctx = jnp.full((B,), prompt_len, jnp.int32)
+        remaining = jnp.full((B,), 10 ** 6, jnp.int32)
+        dtbl = jnp.asarray(np.tile(tbl[:1], (B, 1)))
+        eos = jnp.asarray(-1, jnp.int32)
+        tok_state = jnp.zeros((B,), jnp.int32)
+        _, tok_state, eng.pages = prog(params, tok_state, ctx, remaining,
+                                       eng.pages, dtbl, eos)
+        tok_state.block_until_ready()
+        dt0 = time.monotonic()
+        for _ in range(reps):
+            _, tok_state, eng.pages = prog(
+                params, tok_state, ctx, remaining, eng.pages, dtbl, eos)
+        tok_state.block_until_ready()
+        ddt = time.monotonic() - dt0
+        decode_gbs = reps * K * stream_bytes / ddt / 1e9
+        if hbm_peak:
+            decode_bw_util = decode_gbs * 1e9 / hbm_peak
+        log(f"decode weight traffic: {decode_gbs:.0f} GB/s"
+            + (f" ({decode_bw_util * 100:.0f}% of HBM)" if hbm_peak else "")
+            + f" [{B} lanes -> {B * reps * K / ddt:.0f} tok/s ceiling]")
+    except Exception as exc:  # noqa: BLE001
+        log(f"utilization legs skipped: {exc}")
+    del eng  # free the headline KV pool before the long-prompt engine
 
     # Long-prompt leg: realistic multi-KB diagnosis prompts exercising
     # chunked prefill (prompts > the largest bucket), so the headline number
@@ -125,7 +291,7 @@ def main() -> None:
         long_len = int(os.environ.get("BENCH_LONG_PROMPT_LEN", "1536"))
         lcfg = EngineConfig(
             max_slots=16,
-            num_blocks=2048,
+            num_blocks=1700,
             block_size=16,
             max_blocks_per_seq=128,
             prefill_buckets=(512,),
@@ -156,6 +322,7 @@ def main() -> None:
             np.array(sorted(r.ttft_s for r in lres)), 50)) * 1e3
         log(f"long prompts ({long_len} tok x {n_long}): p50 TTFT "
             f"{long_p50_ms:.1f} ms, drained in {lwall:.2f}s")
+        del leng
     except Exception as exc:  # noqa: BLE001 — extras never fail the bench
         log(f"long-prompt bench skipped: {exc}")
 
@@ -187,6 +354,7 @@ def main() -> None:
 
     extras = {
         "model": model_name,
+        "quant": quant,
         "concurrency": n_requests,
         "prompt_len": prompt_len,
         "max_tokens": max_tokens,
@@ -194,10 +362,25 @@ def main() -> None:
         "throughput_tok_s": round(toks_per_s, 1),
         "wall_s": round(wall, 2),
         "platform": dev.platform,
+        "device_kind": dev.device_kind,
+        "warmup_s": round(warmup_s, 1),
+        "compile_cache_warm": cache_was_warm,
+        "weight_gib": round(weight_bytes / 2**30, 2),
         "embed_docs_per_s": round(embed_docs_per_s, 1),
+        "slo_context": "500ms SLO is v5e-8 (8 chips); this is 1 chip at "
+                       "8x the SLO's per-chip load",
     }
+    if perchip_p50_ms is not None:
+        extras["perchip_equiv_p50_ttft_ms"] = round(perchip_p50_ms, 2)
+    if prefill_tflops:
+        extras["prefill_tflops"] = round(prefill_tflops, 1)
+        extras["prefill_mfu"] = round(prefill_mfu, 3)
+    if decode_gbs:
+        extras["decode_weight_gbs"] = round(decode_gbs, 1)
+        extras["decode_bw_util"] = round(decode_bw_util, 3)
     if long_p50_ms is not None:  # 0.0 would read as a perfect score
         extras["long_prompt_p50_ttft_ms"] = round(long_p50_ms, 2)
+    log(f"total bench time {time.monotonic() - t0:.0f}s")
     print(json.dumps({
         "metric": "p50_ttft_100c_ms",
         "value": round(p50 * 1e3, 2),

@@ -124,10 +124,28 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
 
 
 def _linear(p: Params, x: jnp.ndarray) -> jnp.ndarray:
-    y = x @ p["kernel"]
+    if "kernel_q" in p:
+        # Weight-only int8 (utils/quantize.py): per-output-channel scale
+        # commutes with the contraction, so dequant is a [out]-vector
+        # multiply on the result, never a materialized bf16 weight.  The
+        # int8->activation-dtype cast fuses into the MXU operand read.
+        y = (x @ p["kernel_q"].astype(x.dtype)) * p["scale"].astype(x.dtype)
+    else:
+        y = x @ p["kernel"]
     if "bias" in p:
         y = y + p["bias"]
     return y
+
+
+def _embed_lookup(params: Params, cfg: ModelConfig,
+                  tokens: jnp.ndarray) -> jnp.ndarray:
+    """Token embedding lookup, handling int8-quantized tables."""
+    emb = params["embed"]
+    dtype = jnp.dtype(cfg.dtype)
+    if "weight_q" in emb:
+        rows = emb["weight_q"][tokens].astype(dtype)
+        return rows * emb["scale"][tokens][..., None].astype(dtype)
+    return emb["weight"][tokens]
 
 
 def _qkv(layer: Params, cfg: ModelConfig, x: jnp.ndarray, cos, sin):
@@ -151,7 +169,12 @@ def _mlp(layer: Params, x: jnp.ndarray) -> jnp.ndarray:
 def _unembed(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
     if cfg.tie_embeddings:
-        logits = x @ params["embed"]["weight"].T
+        emb = params["embed"]
+        if "weight_q" in emb:
+            logits = ((x @ emb["weight_q"].T.astype(x.dtype))
+                      * emb["scale"].astype(x.dtype))
+        else:
+            logits = x @ emb["weight"].T
     else:
         logits = _linear(params["lm_head"], x)
     return logits.astype(jnp.float32)
@@ -179,7 +202,7 @@ def forward_full(
     if attn_fn is None:
         attn_fn = causal_attention
     B, S = tokens.shape
-    x = params["embed"]["weight"][tokens]
+    x = _embed_lookup(params, cfg, tokens)
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     cos, sin = rope_angles(positions, cfg.head_dim_, cfg.rope_theta,
@@ -255,7 +278,7 @@ def _prefill_impl(
     cos, sin = rope_angles(positions, cfg.head_dim_, cfg.rope_theta,
                            scaling=cfg.rope_scaling)
 
-    x = params["embed"]["weight"][tokens]
+    x = _embed_lookup(params, cfg, tokens)
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
@@ -374,7 +397,7 @@ def decode_step(
     cos, sin = rope_angles(positions, cfg.head_dim_, cfg.rope_theta,
                            scaling=cfg.rope_scaling)
 
-    x = params["embed"]["weight"][tokens][:, None, :]  # [B, 1, H]
+    x = _embed_lookup(params, cfg, tokens)[:, None, :]  # [B, 1, H]
     new_lens = context_lens + 1
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):

@@ -145,22 +145,44 @@ def paged_decode_attention(
     return decode_attention(q, k, v, lengths)
 
 
-def select_attn_impl(platform: str | None = None):
+def select_attn_impl(platform: str | None = None, cfg=None):
     """Pick the paged-decode attention implementation for the backend.
 
     TPU gets the Pallas kernel (block-table-driven HBM->VMEM streaming,
     ops/pallas_attention.py); everything else (CPU tests, the virtual-device
     dryrun) gets the XLA gather fallback above.
+
+    ``cfg`` (a ModelConfig) gates on kernel geometry: the kernel DMAs pages
+    as [block_size, kv_heads*head_dim] rows, and Mosaic requires that fused
+    lane dim to be 128-aligned (and head_dim <= 128).  Models that fail the
+    gate (tiny test configs) get the XLA path with a logged warning — never
+    a silent compile-time crash or a quiet performance cliff.
     """
+    import logging
+
+    logger = logging.getLogger("k8s_llm_monitor_tpu.ops")
     if platform is None:
         platform = jax.default_backend()
-    if platform == "tpu":
-        try:
-            from k8s_llm_monitor_tpu.ops.pallas_attention import (
-                paged_decode_attention_pallas,
-            )
-
-            return paged_decode_attention_pallas
-        except Exception:  # pragma: no cover - import/lowering unavailable
+    if platform != "tpu":
+        return paged_decode_attention
+    if cfg is not None:
+        fused = cfg.num_kv_heads * cfg.head_dim_
+        if fused % 128 != 0 or cfg.head_dim_ > 128:
+            logger.warning(
+                "Pallas paged-attention kernel unavailable for %s "
+                "(kv_heads*head_dim=%d not 128-aligned or head_dim>128); "
+                "using the XLA gather fallback — O(B*max_ctx) HBM traffic "
+                "per decode step", getattr(cfg, "name", "model"), fused)
             return paged_decode_attention
-    return paged_decode_attention
+    try:
+        from k8s_llm_monitor_tpu.ops.pallas_attention import (
+            paged_decode_attention_pallas,
+        )
+
+        return paged_decode_attention_pallas
+    except Exception as exc:  # pragma: no cover - import/lowering unavailable
+        logger.warning(
+            "Pallas paged-attention kernel failed to import (%s); using the "
+            "XLA gather fallback — O(B*max_ctx) HBM traffic per decode "
+            "step", exc)
+        return paged_decode_attention

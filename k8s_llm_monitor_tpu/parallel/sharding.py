@@ -37,14 +37,18 @@ def _spec_for_path(path: tuple) -> P:
     keys = [p.key for p in path if isinstance(p, jax.tree_util.DictKey)]
     leaf = keys[-1]
     parent = keys[-2] if len(keys) > 1 else ""
-    if parent == "embed" and leaf == "weight":
+    if parent == "embed" and leaf in ("weight", "weight_q"):
         return P("model", None)                    # vocab-parallel
-    if leaf == "kernel":
+    if parent == "embed" and leaf == "scale":
+        return P("model")                          # per-vocab-row scales
+    if leaf in ("kernel", "kernel_q"):
         if parent in _COL:
             return P(None, "model")
         if parent in _ROW:
             return P("model", None)
-    if leaf == "bias":
+    if leaf in ("bias", "scale"):
+        # int8 per-output-channel scales shard with the out dim, exactly
+        # like biases: split for column-parallel, replicated for row.
         return P("model") if parent in _COL else P(None)
     # norms and anything else: replicated
     return P(None)

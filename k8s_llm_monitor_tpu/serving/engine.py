@@ -218,7 +218,7 @@ class InferenceEngine:
             # The Pallas kernel is single-device; under a GSPMD mesh the
             # XLA gather path partitions automatically, so keep it there.
             attn_impl = select_attn_impl(
-                "cpu" if mesh is not None else None)
+                "cpu" if mesh is not None else None, cfg=cfg)
         self._attn_impl = attn_impl
 
         def _prefill_sample_fn(params, tokens, lengths, pages, tables,

@@ -56,13 +56,37 @@ _LINEAR_MAP = {
 
 
 def convert_hf_state_dict(
-    state: Mapping[str, np.ndarray], cfg: ModelConfig, dtype: str | None = None
+    state: Mapping[str, np.ndarray], cfg: ModelConfig,
+    dtype: str | None = None, quantize: bool = False,
 ) -> Params:
-    """Map a HF Llama/Qwen2 state dict (numpy arrays) to our param pytree."""
+    """Map a HF Llama/Qwen2 state dict (numpy arrays) to our param pytree.
+
+    With ``quantize=True``, every linear kernel and the embed/unembed tables
+    are int8-quantized (utils/quantize.py) tensor-by-tensor on the host
+    before transfer — the only way an 8B-class checkpoint fits next to the
+    KV pool on a 16 GB chip.  Quantization happens on the host-side numpy
+    copy, so peak device memory is the quantized size, never the bf16 size.
+    """
     dt = jnp.dtype(dtype or cfg.dtype)
 
     def get(name: str) -> jnp.ndarray:
         return jnp.asarray(np.asarray(state[name]), dtype=dt)
+
+    def linear(weight_key: str, bias_key: str | None = None) -> Params:
+        w = np.asarray(state[weight_key]).T               # [in, out]
+        if quantize:
+            from k8s_llm_monitor_tpu.utils.quantize import quantize_array
+
+            # quantize_array upcasts to f32 internally; the dense path
+            # below converts straight to the target dtype instead.
+            w_q, scale = quantize_array(w, axis=0)
+            p: Params = {"kernel_q": jnp.asarray(w_q),
+                         "scale": jnp.asarray(scale)}
+        else:
+            p = {"kernel": jnp.asarray(w, dtype=dt)}
+        if bias_key is not None and bias_key in state:
+            p["bias"] = get(bias_key)
+        return p
 
     layers = []
     for i in range(cfg.num_layers):
@@ -72,23 +96,29 @@ def convert_hf_state_dict(
             "post_norm": get(pre + "post_attention_layernorm.weight"),
         }
         for ours, theirs in _LINEAR_MAP.items():
-            p: Params = {"kernel": get(f"{pre}{theirs}.weight").T}
-            bias_key = f"{pre}{theirs}.bias"
-            if bias_key in state:
-                p["bias"] = get(bias_key)
-            layer[ours] = p
+            layer[ours] = linear(f"{pre}{theirs}.weight",
+                                 f"{pre}{theirs}.bias")
         layers.append(layer)
 
+    if quantize:
+        from k8s_llm_monitor_tpu.utils.quantize import quantize_array
+
+        w_q, scale = quantize_array(
+            np.asarray(state["model.embed_tokens.weight"], np.float32),
+            axis=1)
+        embed: Params = {"weight_q": jnp.asarray(w_q),
+                         "scale": jnp.asarray(scale)}
+    else:
+        embed = {"weight": get("model.embed_tokens.weight")}
     params: Params = {
-        "embed": {"weight": get("model.embed_tokens.weight")},
+        "embed": embed,
         "layers": layers,
         "final_norm": get("model.norm.weight"),
     }
     if not cfg.tie_embeddings:
-        if "lm_head.weight" in state:
-            params["lm_head"] = {"kernel": get("lm_head.weight").T}
-        else:  # checkpoint ties but config didn't say so
-            params["lm_head"] = {"kernel": get("model.embed_tokens.weight").T}
+        head_key = ("lm_head.weight" if "lm_head.weight" in state
+                    else "model.embed_tokens.weight")  # ties despite config
+        params["lm_head"] = linear(head_key)
     return params
 
 
@@ -126,9 +156,15 @@ class _SafetensorsDict(Mapping[str, np.ndarray]):
 
 
 def load_hf_checkpoint(
-    model_dir: str | pathlib.Path, dtype: str | None = None
+    model_dir: str | pathlib.Path, dtype: str | None = None,
+    quantize: bool = False,
 ) -> tuple[ModelConfig, Params]:
-    """Load a HF-format model directory (config.json + safetensors)."""
+    """Load a HF-format model directory (config.json + safetensors).
+
+    ``quantize=True`` streams each tensor through host-side int8
+    quantization (see convert_hf_state_dict) — required for 8B-class
+    checkpoints on a single 16 GB chip.
+    """
     model_dir = pathlib.Path(model_dir)
     hf_cfg = json.loads((model_dir / "config.json").read_text())
     cfg = ModelConfig(**{
@@ -136,7 +172,8 @@ def load_hf_checkpoint(
         **({"dtype": dtype} if dtype else {}),
     })
     state = _SafetensorsDict(model_dir)
-    return cfg, convert_hf_state_dict(state, cfg, dtype=dtype)
+    return cfg, convert_hf_state_dict(state, cfg, dtype=dtype,
+                                      quantize=quantize)
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,172 @@
+"""Weight-only int8 quantization for the decoder LM.
+
+Why weight-only: TPU decode is HBM-bandwidth-bound — every decode step
+streams the full weight set through the MXU for one token per lane.  Halving
+weight bytes (bf16 -> int8 + per-channel scales) both halves that traffic and
+makes the Llama-3-8B target (~8 GB quantized) fit a 16 GB v5e chip next to
+the paged KV pool, which bf16 weights (~16 GB) cannot.  Activations and the
+KV cache stay bf16: their traffic is small next to weights at serving batch
+sizes, and keeping them wide preserves accuracy.
+
+Scheme: symmetric per-output-channel int8.
+
+    w_q[i, o]  = round(w[i, o] / scale[o]),  scale[o] = max_i |w[i, o]| / 127
+
+The forward pass never materializes a dequantized weight matrix: because the
+scale is per *output* channel it commutes with the contraction,
+
+    x @ (w_q * scale) == (x @ w_q) * scale
+
+so ``models/llama.py:_linear`` runs the matmul on the int8 kernel (upcast to
+the activation dtype on the fly — a cast XLA fuses into the MXU operand
+read, so HBM still only moves int8 bytes) and applies the scale to the
+[.., out] result.  int8 values are exact in bfloat16 (|v| <= 127 < 2^8), so
+the upcast loses nothing.
+
+Embedding / unembedding use the same scheme per vocab row (the embed matrix
+is its own transpose-partner when tied).
+
+Quantized pytree leaves replace their bf16 counterparts in place:
+
+    linear:  {"kernel": [in, out] bf16}        -> {"kernel_q": int8, "scale": f32 [out]}
+    embed:   {"weight": [vocab, H] bf16}       -> {"weight_q": int8, "scale": f32 [vocab]}
+
+``bias`` entries (Qwen2 QKV) stay in the activation dtype.
+
+Capability context: the reference's LLM layer is config-only (reference
+internal/config/config.go:141-145); serving the real Llama-3-8B target on a
+single 16 GB chip is a north-star obligation (BASELINE.md configs #2/#4),
+and this module is what makes the geometry fit.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from k8s_llm_monitor_tpu.models.config import ModelConfig
+
+Params = dict[str, Any]
+
+_EPS = 1e-12
+
+
+def quantize_array(w: np.ndarray, axis: int = 0) -> tuple[np.ndarray, np.ndarray]:
+    """Symmetric int8 quantization of ``w`` with scales over ``axis``.
+
+    Host-side numpy (streaming checkpoint load must not touch the device).
+    Returns (w_q int8 same shape, scale float32 with ``axis`` reduced).
+    """
+    w = np.asarray(w, np.float32)
+    amax = np.max(np.abs(w), axis=axis)
+    scale = np.maximum(amax / 127.0, _EPS).astype(np.float32)
+    w_q = np.rint(w / np.expand_dims(scale, axis)).astype(np.int8)
+    return w_q, scale
+
+
+def quantize_linear(p: Params) -> Params:
+    """{"kernel": [in, out], ...} -> {"kernel_q", "scale", ...}."""
+    w_q, scale = quantize_array(np.asarray(p["kernel"]), axis=0)
+    out: Params = {"kernel_q": jnp.asarray(w_q), "scale": jnp.asarray(scale)}
+    if "bias" in p:
+        out["bias"] = p["bias"]
+    return out
+
+
+def quantize_embed(p: Params) -> Params:
+    """{"weight": [vocab, H]} -> {"weight_q", "scale"} (per-row scales)."""
+    w_q, scale = quantize_array(np.asarray(p["weight"]), axis=1)
+    return {"weight_q": jnp.asarray(w_q), "scale": jnp.asarray(scale)}
+
+
+def quantize_params(params: Params) -> Params:
+    """Quantize a full llama param pytree (see models/llama.py layout).
+
+    Norm vectors stay in their original dtype — they are O(hidden) bytes and
+    scale-sensitive.
+    """
+    layers = []
+    for layer in params["layers"]:
+        ql: Params = {
+            "input_norm": layer["input_norm"],
+            "post_norm": layer["post_norm"],
+        }
+        for name in ("q", "k", "v", "o", "gate", "up", "down"):
+            ql[name] = quantize_linear(layer[name])
+        layers.append(ql)
+    out: Params = {
+        "embed": quantize_embed(params["embed"]),
+        "layers": layers,
+        "final_norm": params["final_norm"],
+    }
+    if "lm_head" in params:
+        out["lm_head"] = quantize_linear(params["lm_head"])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Direct quantized random init (benchmarks)
+# ---------------------------------------------------------------------------
+
+
+def init_params_quantized(rng: jax.Array, cfg: ModelConfig) -> Params:
+    """Random-init parameters directly in int8 + scales.
+
+    The 8B-class bench configs cannot materialize bf16 weights first (16 GB
+    on a 16 GB chip) — this builds each tensor already quantized, with scales
+    matching the magnitude ``models/llama.py:init_params`` would produce
+    (kernel std in**-0.5, embed std 0.02), so activations have realistic
+    dynamic range.
+    """
+    dtype = jnp.dtype(cfg.dtype)
+    H, D = cfg.hidden_size, cfg.head_dim_
+    nH, nKV, I = cfg.num_heads, cfg.num_kv_heads, cfg.intermediate_size
+
+    def qdense(key, in_f, out_f, bias):
+        # ~N(0, in**-0.5) truncated at 3 sigma -> amax ~= 3 * std.
+        w_q = jax.random.randint(key, (in_f, out_f), -127, 128, jnp.int8)
+        scale = jnp.full((out_f,), 3.0 * (in_f ** -0.5) / 127.0, jnp.float32)
+        p: Params = {"kernel_q": w_q, "scale": scale}
+        if bias:
+            p["bias"] = jnp.zeros((out_f,), dtype)
+        return p
+
+    keys = jax.random.split(rng, 2 + cfg.num_layers)
+    layers = []
+    for i in range(cfg.num_layers):
+        lk = jax.random.split(keys[2 + i], 7)
+        layers.append(
+            {
+                "input_norm": jnp.ones((H,), dtype),
+                "post_norm": jnp.ones((H,), dtype),
+                "q": qdense(lk[0], H, nH * D, cfg.qkv_bias),
+                "k": qdense(lk[1], H, nKV * D, cfg.qkv_bias),
+                "v": qdense(lk[2], H, nKV * D, cfg.qkv_bias),
+                "o": qdense(lk[3], nH * D, H, False),
+                "gate": qdense(lk[4], H, I, False),
+                "up": qdense(lk[5], H, I, False),
+                "down": qdense(lk[6], I, H, False),
+            }
+        )
+    params: Params = {
+        "embed": {
+            "weight_q": jax.random.randint(
+                keys[0], (cfg.vocab_size, H), -127, 128, jnp.int8),
+            "scale": jnp.full((cfg.vocab_size,), 3.0 * 0.02 / 127.0,
+                              jnp.float32),
+        },
+        "layers": layers,
+        "final_norm": jnp.ones((H,), dtype),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = qdense(keys[1], H, cfg.vocab_size, False)
+    return params
+
+
+def param_bytes(params: Params) -> int:
+    """Total weight bytes as stored (int8 kernels count 1 byte/element)."""
+    return sum(x.size * x.dtype.itemsize
+               for x in jax.tree_util.tree_leaves(params))

@@ -1,0 +1,187 @@
+"""int8 weight-only quantization: roundtrip bounds, logits parity vs the
+bf16/f32 model, engine generation on quantized params, and TP sharding of
+the quantized pytree.
+
+This is the path that serves the real Llama-3-8B target on a 16 GB chip
+(VERDICT r3 item 1); the parity tolerances here are the "within tolerance"
+contract for that claim.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from k8s_llm_monitor_tpu.models import llama
+from k8s_llm_monitor_tpu.models.config import ModelConfig
+from k8s_llm_monitor_tpu.serving.engine import (
+    EngineConfig,
+    InferenceEngine,
+    SamplingParams,
+)
+from k8s_llm_monitor_tpu.utils import quantize as qz
+
+CFG = ModelConfig(name="t", vocab_size=256, hidden_size=64,
+                  intermediate_size=128, num_layers=2, num_heads=4,
+                  num_kv_heads=2, dtype="float32", rope_theta=10_000.0)
+
+CFG_TIED = ModelConfig(name="t-tied", vocab_size=256, hidden_size=64,
+                       intermediate_size=128, num_layers=2, num_heads=4,
+                       num_kv_heads=2, dtype="float32",
+                       rope_theta=10_000.0, tie_embeddings=True)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def test_quantize_roundtrip_error_bound():
+    rng = np.random.default_rng(0)
+    w = rng.normal(0, 0.05, size=(96, 48)).astype(np.float32)
+    w_q, scale = qz.quantize_array(w, axis=0)
+    assert w_q.dtype == np.int8 and scale.shape == (48,)
+    deq = w_q.astype(np.float32) * scale[None, :]
+    # Symmetric 8-bit: error per element <= scale/2 = amax/254.
+    amax = np.abs(w).max(axis=0)
+    assert np.all(np.abs(deq - w) <= amax[None, :] / 254 + 1e-7)
+
+
+def test_quantized_linear_matches_dequantized(params):
+    """(x @ w_q) * scale must equal x @ (w_q * scale) — the algebra the
+    fused dequant relies on."""
+    layer = params["layers"][0]["gate"]
+    qp = qz.quantize_linear(layer)
+    x = jax.random.normal(jax.random.PRNGKey(1), (3, CFG.hidden_size),
+                          jnp.float32)
+    fused = llama._linear(qp, x)
+    explicit = x @ (qp["kernel_q"].astype(jnp.float32)
+                    * qp["scale"][None, :])
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(explicit),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("cfg", [CFG, CFG_TIED], ids=["untied", "tied"])
+def test_forward_logits_parity(cfg):
+    """Full-model logits of the int8 pytree track the f32 reference."""
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    qparams = qz.quantize_params(params)
+    toks = jax.random.randint(jax.random.PRNGKey(2), (2, 24), 0,
+                              cfg.vocab_size)
+    ref = np.asarray(llama.forward_full(params, cfg, toks))
+    got = np.asarray(llama.forward_full(qparams, cfg, toks))
+    # Per-position cosine similarity of the logit vectors.
+    dot = (ref * got).sum(-1)
+    cos = dot / (np.linalg.norm(ref, axis=-1)
+                 * np.linalg.norm(got, axis=-1) + 1e-9)
+    assert cos.min() > 0.99, f"min cosine {cos.min()}"
+    # And the probability mass moved stays small.
+    err = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+    assert err < 0.2, f"relative max logit error {err}"
+
+
+def test_engine_generation_on_quantized_params(params):
+    """prefill+paged-decode on the quantized pytree is self-consistent with
+    dense forward of the same quantized weights (exercises _embed_lookup,
+    _linear, and _unembed quantized branches through the whole stack)."""
+    qparams = qz.quantize_params(params)
+    eng = InferenceEngine(
+        CFG, qparams,
+        EngineConfig(max_slots=4, num_blocks=64, block_size=8,
+                     max_blocks_per_seq=16, prefill_buckets=(16, 32)),
+        eos_id=-1,
+    )
+    rng = np.random.default_rng(3)
+    prompts = [list(rng.integers(3, 250, size=n)) for n in (5, 12)]
+    results = eng.generate(prompts, SamplingParams(max_tokens=6))
+
+    def naive(prompt, n):
+        seq = list(prompt)
+        for _ in range(n):
+            logits = llama.forward_full(
+                qparams, CFG, jnp.asarray([seq], jnp.int32))
+            seq.append(int(jnp.argmax(logits[0, -1])))
+        return seq[len(prompt):]
+
+    for p, r in zip(prompts, results):
+        assert r.finish_reason == "length"
+        assert r.token_ids == naive(p, 6)
+
+
+def test_init_params_quantized_runs():
+    """Direct quantized random init (the 8B bench path) generates."""
+    qparams = qz.init_params_quantized(jax.random.PRNGKey(0), CFG)
+    assert qparams["layers"][0]["q"]["kernel_q"].dtype == jnp.int8
+    eng = InferenceEngine(
+        CFG, qparams,
+        EngineConfig(max_slots=2, num_blocks=32, block_size=8,
+                     max_blocks_per_seq=8, prefill_buckets=(16,)),
+        eos_id=-1,
+    )
+    res = eng.generate([[5, 6, 7]], SamplingParams(max_tokens=4))[0]
+    assert res.finish_reason == "length" and len(res.token_ids) == 4
+
+
+def test_quantized_param_bytes_halve(params):
+    dense = qz.param_bytes(jax.tree.map(
+        lambda x: x.astype(jnp.bfloat16) if x.dtype == jnp.float32 else x,
+        params))
+    quant = qz.param_bytes(qz.quantize_params(params))
+    assert quant < 0.75 * dense  # int8 kernels + small f32 scales
+
+
+def test_quantized_pytree_shards_over_mesh(params):
+    """TP partition specs cover kernel_q/scale; device_put succeeds on the
+    virtual 8-device mesh (2-way model axis on the tiny shapes)."""
+    from jax.sharding import Mesh, NamedSharding
+    from k8s_llm_monitor_tpu.parallel.sharding import param_partition_specs
+
+    qparams = qz.quantize_params(params)
+    devs = np.array(jax.devices()[:2]).reshape(2)
+    mesh = Mesh(devs, ("model",))
+    specs = param_partition_specs(qparams)
+    sharded = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        qparams, specs)
+    # Column-parallel scale must actually be split over the model axis.
+    q_scale = sharded["layers"][0]["q"]["scale"]
+    shard_shapes = {tuple(sh.data.shape) for sh in q_scale.addressable_shards}
+    assert shard_shapes == {(q_scale.shape[0] // 2,)}
+
+
+def test_hf_streaming_quantized_load():
+    """convert_hf_state_dict(quantize=True) produces a quantized pytree whose
+    logits track the unquantized load of the same state dict."""
+    torch = pytest.importorskip("torch")
+    import transformers
+
+    from k8s_llm_monitor_tpu.utils.checkpoint import (
+        config_from_hf,
+        convert_hf_state_dict,
+    )
+
+    hf_cfg = transformers.LlamaConfig(
+        vocab_size=128, hidden_size=64, intermediate_size=160,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=256, rope_theta=500000.0,
+        tie_word_embeddings=False, attn_implementation="eager")
+    model = transformers.LlamaForCausalLM(hf_cfg)
+    torch.manual_seed(0)
+    for p in model.parameters():
+        with torch.no_grad():
+            p.copy_(torch.randn_like(p) * 0.05)
+    state = {k: v.numpy() for k, v in model.state_dict().items()}
+    cfg = config_from_hf(hf_cfg.to_dict(), name="tiny-hf")
+    cfg = type(cfg)(**{**cfg.__dict__, "dtype": "float32"})
+
+    ref_params = convert_hf_state_dict(state, cfg)
+    q_params = convert_hf_state_dict(state, cfg, quantize=True)
+    assert "weight_q" in q_params["embed"]
+    toks = jnp.asarray([[1, 5, 9, 80, 3, 44]], jnp.int32)
+    ref = np.asarray(llama.forward_full(ref_params, cfg, toks))
+    got = np.asarray(llama.forward_full(q_params, cfg, toks))
+    cos = ((ref * got).sum(-1)
+           / (np.linalg.norm(ref, axis=-1) * np.linalg.norm(got, axis=-1)
+              + 1e-9))
+    assert cos.min() > 0.99
