@@ -45,7 +45,15 @@ Params = dict[str, Any]
 class KVPages(NamedTuple):
     """Paged KV cache: per-layer lists of page arrays.
 
-    k[i], v[i]: [num_blocks, block_size, kv_heads, head_dim]
+    k[i], v[i]: [num_blocks, block_size, kv_heads * head_dim]
+
+    The kv-heads and head-dim axes are stored FUSED.  This is the Pallas
+    decode kernel's native DMA layout (128-lane-aligned page rows); keeping
+    the resident arrays in that layout means the per-step attention call
+    consumes them directly.  Storing [..., KVH, D] instead costs a physical
+    relayout copy of every page array on every decode step (~4.6 GB/step
+    for 8B at 2200 blocks — measured as 64 materialized reshapes in the
+    compiled HLO, and most of the decode step time).
     """
 
     k: list[jnp.ndarray]
@@ -62,7 +70,7 @@ class KVPages(NamedTuple):
 
 def init_kv_pages(cfg: ModelConfig, num_blocks: int, block_size: int) -> KVPages:
     dtype = jnp.dtype(cfg.dtype)
-    shape = (num_blocks, block_size, cfg.num_kv_heads, cfg.head_dim_)
+    shape = (num_blocks, block_size, cfg.num_kv_heads * cfg.head_dim_)
     return KVPages(
         k=[jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)],
         v=[jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)],
@@ -233,7 +241,7 @@ def _scatter_pages(
 
     Invalid lanes are redirected to the null block 0.
 
-    pages: [num_blocks, bs, KVH, D]; vals: [B, S, KVH, D];
+    pages: [num_blocks, bs, KVH*D] (fused lane layout); vals: [B, S, KVH, D];
     block_table: [B, max_blocks]; positions/valid: [B, S].
     """
     bs = pages.shape[1]
@@ -245,7 +253,7 @@ def _scatter_pages(
     offs = positions % bs
     flat_blocks = block_ids.reshape(-1)
     flat_offs = offs.reshape(-1)
-    flat_vals = vals.reshape(B * S, vals.shape[2], vals.shape[3])
+    flat_vals = vals.reshape(B * S, -1)              # fuse [KVH, D] -> lanes
     return pages.at[flat_blocks, flat_offs].set(flat_vals)
 
 
@@ -288,7 +296,13 @@ def _prefill_impl(
         new_k.append(pk)
         new_v.append(pv)
         if attend_to_pages:
-            kk, vv = gather_pages(pk, block_tables), gather_pages(pv, block_tables)
+            # Gathered view is [B, T, KVH*D]; unfuse for attention (the
+            # reshape touches the small gathered activation, never the
+            # resident page arrays).
+            kk = gather_pages(pk, block_tables).reshape(
+                B, -1, cfg.num_kv_heads, cfg.head_dim_)
+            vv = gather_pages(pv, block_tables).reshape(
+                B, -1, cfg.num_kv_heads, cfg.head_dim_)
         else:
             kk, vv = k, v
         attn = causal_attention(q, kk, vv, q_positions=positions, kv_len=kv_len)

@@ -6,8 +6,11 @@ All variants are GQA-aware (``num_heads`` query heads grouped over
 
 Layout conventions (chosen for TPU):
   activations  [batch, seq, heads, head_dim]
-  paged KV     [num_blocks, block_size, kv_heads, head_dim]
-  block table  [batch, max_blocks_per_seq] int32 (block ids; -1 = unused)
+  paged KV     [num_blocks, block_size, kv_heads * head_dim] — the fused
+               lane layout of models/llama.py:KVPages (128-lane-aligned
+               page rows the Pallas kernel DMAs directly)
+  block table  [batch, max_blocks_per_seq] int32 (block ids; entries past a
+               sequence's pages are 0, the reserved null block)
 
 The pure-XLA paged path here is the reference implementation and the CPU/test
 fallback; the Pallas TPU kernel lives in ops/pallas_attention.py and is
@@ -112,18 +115,19 @@ def gather_pages(
     """Gather a sequence's KV pages into a contiguous view.
 
     Args:
-      pages: [num_blocks, block_size, KVH, D].
+      pages: [num_blocks, block_size, KVH*D] (fused lane layout — see
+        models/llama.py:KVPages).
       block_table: [B, max_blocks] int32 (entries may be -1 / garbage past the
         sequence's length — callers mask by length).
 
     Returns:
-      [B, max_blocks * block_size, KVH, D].
+      [B, max_blocks * block_size, KVH*D].
     """
     B, max_blocks = block_table.shape
     bs = pages.shape[1]
     safe = jnp.maximum(block_table, 0)
-    g = pages[safe]  # [B, max_blocks, bs, KVH, D]
-    return g.reshape(B, max_blocks * bs, g.shape[3], g.shape[4])
+    g = pages[safe]  # [B, max_blocks, bs, KVH*D]
+    return g.reshape(B, max_blocks * bs, g.shape[3])
 
 
 def paged_decode_attention(
@@ -135,13 +139,16 @@ def paged_decode_attention(
 ) -> jnp.ndarray:
     """Single-token decode against a paged (block) KV cache — XLA reference.
 
-    Gathers each sequence's blocks into a contiguous [B, max_blocks*bs, ...]
-    view then runs masked decode attention.  The Pallas kernel avoids the
-    gather by streaming pages HBM->VMEM per block; this version is the
-    semantics reference and the CPU fallback.
+    Gathers each sequence's blocks into a contiguous [B, max_blocks*bs, F]
+    view then runs masked decode attention (unfusing F -> [KVH, D] on the
+    gathered activation only).  The Pallas kernel avoids the gather by
+    streaming pages HBM->VMEM per block; this version is the semantics
+    reference and the CPU fallback.
     """
-    k = gather_pages(k_pages, block_table)
-    v = gather_pages(v_pages, block_table)
+    B = q.shape[0]
+    D = q.shape[-1]
+    k = gather_pages(k_pages, block_table).reshape(B, -1, k_pages.shape[2] // D, D)
+    v = gather_pages(v_pages, block_table).reshape(B, -1, v_pages.shape[2] // D, D)
     return decode_attention(q, k, v, lengths)
 
 

@@ -60,20 +60,22 @@ def param_partition_specs(params: Any) -> Any:
 
 
 def kv_pages_partition_specs(
-    pages: KVPages, mesh: Mesh | None = None
+    pages: KVPages, mesh: Mesh | None, num_kv_heads: int,
 ) -> KVPages:
-    """[num_blocks, block_size, kv_heads, head_dim] -> shard kv_heads.
+    """[num_blocks, block_size, kv_heads*head_dim] -> shard the fused lane
+    dim on kv-head boundaries.
 
-    When the mesh's ``model`` axis is larger than the kv-heads axis (TP >
-    num_kv_heads, e.g. 8-KV-head 70B on v5p-16), partitioning kv_heads would
-    not divide evenly and jit/device_put fail — replicate the pages instead.
+    The fused layout is kv-head-major, so splitting the lane dim ``tp`` ways
+    is exactly a kv-head split when ``tp`` divides ``num_kv_heads``.  When
+    TP exceeds the kv-head count (8-KV-head 70B on v5p-16) a lane split
+    would cut heads mid-``head_dim`` (every q·k dot would need a psum) —
+    replicate the pages instead, trading HBM for locality.
     """
-    num_kv_heads = pages.k[0].shape[2]
     tp = mesh.shape["model"] if mesh is not None else 1
     if mesh is not None and (tp > num_kv_heads or num_kv_heads % tp != 0):
-        spec = P(None, None, None, None)
+        spec = P(None, None, None)
     else:
-        spec = P(None, None, "model", None)
+        spec = P(None, None, "model")
     return KVPages(
         k=[spec for _ in pages.k],
         v=[spec for _ in pages.v],

@@ -58,7 +58,11 @@ import numpy as np
 from k8s_llm_monitor_tpu.models import llama
 from k8s_llm_monitor_tpu.models.config import ModelConfig
 from k8s_llm_monitor_tpu.ops.sampling import greedy_tokens, sample_tokens
-from k8s_llm_monitor_tpu.serving.kv_cache import BlockAllocator, OutOfBlocks
+from k8s_llm_monitor_tpu.serving.kv_cache import (
+    BlockAllocator,
+    OutOfBlocks,
+    PrefixCache,
+)
 
 
 @dataclasses.dataclass
@@ -108,6 +112,11 @@ class EngineConfig:
     decode_steps_per_iter: int = 8
     # Dispatch-ahead depth: calls in flight before reconciling the oldest.
     max_inflight: int = 2
+    # Prompt-prefix KV reuse (serving/kv_cache.py:PrefixCache): LRU entry
+    # cap (one entry per cached prefix *length*; host-side tuples, cheap);
+    # 0 disables.  Shared blocks are read-only by construction, so this is
+    # refcounting, not copy-on-write.
+    prefix_cache_entries: int = 1024
 
 
 class _Slot:
@@ -202,7 +211,8 @@ class InferenceEngine:
                 lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
                 params, pspecs,
             )
-            kvspecs = kv_pages_partition_specs(pages, mesh)
+            kvspecs = kv_pages_partition_specs(
+                pages, mesh, num_kv_heads=cfg.num_kv_heads)
             pages = llama.KVPages(
                 k=[jax.device_put(x, NamedSharding(mesh, s))
                    for x, s in zip(pages.k, kvspecs.k)],
@@ -212,6 +222,9 @@ class InferenceEngine:
         self.params = params
         self.pages = pages
         self.allocator = BlockAllocator(ec.num_blocks, ec.block_size)
+        self.prefix_cache: Optional[PrefixCache] = (
+            PrefixCache(self.allocator, ec.prefix_cache_entries)
+            if ec.prefix_cache_entries > 0 else None)
 
         if attn_impl is None:
             from k8s_llm_monitor_tpu.ops.attention import select_attn_impl
@@ -245,6 +258,26 @@ class InferenceEngine:
                 params, cfg, tokens, start, lengths, pages, tables
             )
 
+        def _prefill_chunk_sample_fn(params, tokens, start, lengths, pages,
+                                     tables, temp, topk, topp, rng):
+            # Batched admission over cached prefixes: each lane ingests only
+            # its unshared suffix (start = shared tokens, 0 for misses) and
+            # samples its first token in the same program.
+            logits, pages = llama.prefill_chunk(
+                params, cfg, tokens, start, lengths, pages, tables
+            )
+            first = sample_tokens(
+                rng, logits, temperature=temp, top_k=topk, top_p=topp
+            )
+            return first, pages
+
+        def _prefill_chunk_greedy_fn(params, tokens, start, lengths, pages,
+                                     tables):
+            logits, pages = llama.prefill_chunk(
+                params, cfg, tokens, start, lengths, pages, tables
+            )
+            return greedy_tokens(logits), pages
+
         def _place_fn(tok_state, first, idx):
             # Scatter freshly sampled first tokens into the device-resident
             # token buffer; padding lanes carry idx == max_slots and drop.
@@ -254,6 +287,10 @@ class InferenceEngine:
         self._prefill_sample = jax.jit(_prefill_sample_fn, donate_argnums=(3,))
         self._prefill_greedy = jax.jit(_prefill_greedy_fn, donate_argnums=(3,))
         self._prefill_chunk = jax.jit(_prefill_chunk_fn, donate_argnums=(4,))
+        self._prefill_chunk_sample = jax.jit(
+            _prefill_chunk_sample_fn, donate_argnums=(4,))
+        self._prefill_chunk_greedy = jax.jit(
+            _prefill_chunk_greedy_fn, donate_argnums=(4,))
         self._place_tokens = jax.jit(_place_fn, donate_argnums=(0,))
         self._sample = jax.jit(
             lambda rng, logits, t, k, p: sample_tokens(
@@ -445,14 +482,30 @@ class InferenceEngine:
         if self.token_sink is not None and toks:
             self.token_sink(req.request_id, toks, None)
 
+    def _ensure_free(self, num_tokens: int) -> bool:
+        """Make room for ``num_tokens`` of new blocks, evicting LRU prefix
+        cache entries if needed.  Eviction drops the cache's reference; a
+        block only returns to the free list when no live slot shares it."""
+        while not self.allocator.can_alloc(num_tokens):
+            if self.prefix_cache is None or not self.prefix_cache.evict_lru():
+                return False
+        return True
+
     def _admit_round(self) -> bool:
         """Dispatch one batched prefill+sample call for up to
         ``max_prefills_per_step`` pending prompts.  Returns True if anything
-        was dispatched."""
+        was dispatched.
+
+        Each candidate first consults the prefix cache; a hit turns its
+        prefill into a suffix-only chunked ingestion over the shared pages.
+        Rounds where every lane is a miss keep the dense prefill path (no
+        page gather); any hit switches the round to the chunked program.
+        """
         ec = self.ecfg
         top = ec.prefill_buckets[-1]
         free = self._free_slots()
-        batch: list[tuple[int, GenerationRequest, list[int]]] = []
+        # Entries: (slot_idx, req, blocks, shared_toks)
+        batch: list[tuple[int, GenerationRequest, list[int], int]] = []
         while len(batch) < ec.max_prefills_per_step and self._pending and free:
             req = self._pending[0]
             L = len(req.prompt_ids)
@@ -464,26 +517,38 @@ class InferenceEngine:
                     req, f"prompt of {L} tokens exceeds capacity "
                          f"{self.capacity_tokens}")
                 continue
-            if not self.allocator.can_alloc(L + 1):
+            shared: list[int] = []
+            shared_toks = 0
+            if self.prefix_cache is not None:
+                shared, shared_toks = self.prefix_cache.lookup(req.prompt_ids)
+            if not self._ensure_free(L + 1 - shared_toks):
+                if shared:
+                    self.allocator.free(shared)
                 break
-            if L > top:
-                # Long prompt: serial chunked admission, alone in its round
+            if L - shared_toks > top:
+                # Long suffix: serial chunked admission, alone in its round
                 # (the chunk loop runs per-request; batching short prompts
                 # around it would hold their first tokens hostage).
                 if batch:
+                    if shared:
+                        self.allocator.free(shared)
                     break
                 self._pending.popleft()
-                self._admit_long(req, free[0])
+                self._admit_long(req, free[0], shared, shared_toks)
                 return True
             self._pending.popleft()
-            batch.append((free.pop(0), req, self.allocator.alloc(L + 1)))
+            blocks = shared + self.allocator.alloc(L + 1 - shared_toks)
+            batch.append((free.pop(0), req, blocks, shared_toks))
         if not batch:
             return False
 
         # Fixed lane counts (1 or the max) keep the compile cache small.
         P = 1 if len(batch) == 1 else ec.max_prefills_per_step
-        bucket = self._bucket(max(len(r.prompt_ids) for _, r, _ in batch))
+        any_shared = any(st > 0 for _, _, _, st in batch)
+        bucket = self._bucket(
+            max(len(r.prompt_ids) - st for _, r, _, st in batch))
         tokens = np.zeros((P, bucket), np.int32)
+        start = np.zeros((P,), np.int32)
         lengths = np.zeros((P,), np.int32)
         tables = np.zeros((P, ec.max_blocks_per_seq), np.int32)
         # Padding lanes scatter their (garbage) first token out of range.
@@ -491,41 +556,66 @@ class InferenceEngine:
         temp = np.zeros((P,), np.float32)
         topk = np.zeros((P,), np.int32)
         topp = np.ones((P,), np.float32)
-        for j, (slot_idx, req, blocks) in enumerate(batch):
+        for j, (slot_idx, req, blocks, st) in enumerate(batch):
             L = len(req.prompt_ids)
             if req.orig_prompt_len < 0:
                 req.orig_prompt_len = L
-            tokens[j, :L] = req.prompt_ids
-            lengths[j] = L
+            tokens[j, : L - st] = req.prompt_ids[st:]
+            start[j] = st
+            lengths[j] = L - st
             tables[j, : len(blocks)] = blocks
             idx[j] = slot_idx
             sp = req.sampling
             temp[j], topk[j], topp[j] = sp.temperature, sp.top_k, sp.top_p
 
-        if all(r.sampling.temperature <= 0.0 for _, r, _ in batch):
-            first, self.pages = self._prefill_greedy(
-                self.params, jnp.asarray(tokens), jnp.asarray(lengths),
-                self.pages, jnp.asarray(tables),
-            )
+        all_greedy = all(r.sampling.temperature <= 0.0 for _, r, _, _ in batch)
+        if not any_shared:
+            if all_greedy:
+                first, self.pages = self._prefill_greedy(
+                    self.params, jnp.asarray(tokens), jnp.asarray(lengths),
+                    self.pages, jnp.asarray(tables),
+                )
+            else:
+                self._rng, sub = jax.random.split(self._rng)
+                first, self.pages = self._prefill_sample(
+                    self.params, jnp.asarray(tokens), jnp.asarray(lengths),
+                    self.pages, jnp.asarray(tables), jnp.asarray(temp),
+                    jnp.asarray(topk), jnp.asarray(topp), sub,
+                )
         else:
-            self._rng, sub = jax.random.split(self._rng)
-            first, self.pages = self._prefill_sample(
-                self.params, jnp.asarray(tokens), jnp.asarray(lengths),
-                self.pages, jnp.asarray(tables), jnp.asarray(temp),
-                jnp.asarray(topk), jnp.asarray(topp), sub,
-            )
-        self._finish_admit_dispatch(first, batch, idx)
+            if all_greedy:
+                first, self.pages = self._prefill_chunk_greedy(
+                    self.params, jnp.asarray(tokens), jnp.asarray(start),
+                    jnp.asarray(lengths), self.pages, jnp.asarray(tables),
+                )
+            else:
+                self._rng, sub = jax.random.split(self._rng)
+                first, self.pages = self._prefill_chunk_sample(
+                    self.params, jnp.asarray(tokens), jnp.asarray(start),
+                    jnp.asarray(lengths), self.pages, jnp.asarray(tables),
+                    jnp.asarray(temp), jnp.asarray(topk),
+                    jnp.asarray(topp), sub,
+                )
+        if self.prefix_cache is not None:
+            for slot_idx, req, blocks, st in batch:
+                self.prefix_cache.register(req.prompt_ids, blocks)
+        self._finish_admit_dispatch(
+            first, [(s, r, b) for s, r, b, _ in batch], idx)
         return True
 
-    def _admit_long(self, req: GenerationRequest, slot_idx: int) -> None:
-        """Chunked prefill for prompts longer than the largest bucket: the
-        first chunk runs the dense path, continuations attend to the paged
-        prefix (llama.prefill_chunk)."""
+    def _admit_long(self, req: GenerationRequest, slot_idx: int,
+                    shared: list[int] | None = None,
+                    shared_toks: int = 0) -> None:
+        """Chunked prefill for prompts whose unshared suffix exceeds the
+        largest bucket: the first chunk runs the dense path (when nothing is
+        cached), continuations attend to the paged prefix
+        (llama.prefill_chunk).  A prefix-cache hit skips straight to the
+        chunk loop at ``shared_toks``."""
         ec = self.ecfg
         L = len(req.prompt_ids)
         if req.orig_prompt_len < 0:
             req.orig_prompt_len = L
-        blocks = self.allocator.alloc(L + 1)
+        blocks = (shared or []) + self.allocator.alloc(L + 1 - shared_toks)
         table = np.zeros((1, ec.max_blocks_per_seq), np.int32)
         table[0, : len(blocks)] = blocks
         table_j = jnp.asarray(table)
@@ -534,18 +624,20 @@ class InferenceEngine:
         sp = req.sampling
         self._rng, sub = jax.random.split(self._rng)
 
-        # First chunk (dense path); its sampled token is discarded — only the
-        # final chunk's logits matter.
-        tokens = np.zeros((1, top), np.int32)
-        tokens[0, :top] = req.prompt_ids[:top]
-        _, self.pages = self._prefill_sample(
-            self.params, jnp.asarray(tokens),
-            jnp.asarray([top], jnp.int32), self.pages, table_j,
-            jnp.asarray([0.0], jnp.float32),
-            jnp.asarray([0], jnp.int32),
-            jnp.asarray([1.0], jnp.float32), sub,
-        )
-        pos = top
+        pos = shared_toks
+        if pos == 0:
+            # First chunk (dense path); its sampled token is discarded —
+            # only the final chunk's logits matter.
+            tokens = np.zeros((1, top), np.int32)
+            tokens[0, :top] = req.prompt_ids[:top]
+            _, self.pages = self._prefill_sample(
+                self.params, jnp.asarray(tokens),
+                jnp.asarray([top], jnp.int32), self.pages, table_j,
+                jnp.asarray([0.0], jnp.float32),
+                jnp.asarray([0], jnp.int32),
+                jnp.asarray([1.0], jnp.float32), sub,
+            )
+            pos = top
         logits = None
         while pos < L:
             n = min(L - pos, top)
@@ -565,6 +657,8 @@ class InferenceEngine:
             jnp.asarray([sp.top_k], jnp.int32),
             jnp.asarray([sp.top_p], jnp.float32),
         )
+        if self.prefix_cache is not None:
+            self.prefix_cache.register(req.prompt_ids, blocks)
         self._finish_admit_dispatch(
             first, [(slot_idx, req, blocks)],
             np.asarray([slot_idx], np.int32))
@@ -712,6 +806,11 @@ class InferenceEngine:
                     self.allocator.extend(s.blocks, s.ctx_pred + steps_i)
                     break
                 except OutOfBlocks:
+                    # Cheapest relief first: drop cached prefixes nobody is
+                    # actively using before draining/preempting live work.
+                    if (self.prefix_cache is not None
+                            and self.prefix_cache.evict_lru()):
+                        continue
                     self._reconcile_all()
                     if self._slots[i] is not s or s.retired:
                         break

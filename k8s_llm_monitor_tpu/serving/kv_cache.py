@@ -1,16 +1,38 @@
-"""Host-side paged KV-cache block allocator.
+"""Host-side paged KV-cache block management: refcounted allocator + prefix
+cache.
 
-The device-side page arrays live in models/llama.py (KVPages); this class
-owns the free list and per-sequence block accounting.  Block id 0 is the
-null block — masked lanes in prefill/decode scatter there — so it is never
-handed out.
+The device-side page arrays live in models/llama.py (KVPages); these classes
+own the free list, per-block reference counts, and the prompt-prefix reuse
+map.  Block id 0 is the null block — masked lanes in prefill/decode scatter
+there — so it is never handed out.
 
-Deliberately simple (free-list LIFO, no copy-on-write / prefix sharing yet);
-the continuous-batching engine calls alloc/extend/free on request admission,
-block-boundary crossings, and completion.
+Prefix sharing design (TPU-first, no copy-on-write needed):
+
+  * Only *full* blocks covered entirely by a prompt are ever shared
+    (``n = len(prompt) // block_size`` blocks, capped so at least one prompt
+    token always remains unshared).  KV content of such a block is a pure
+    function of the token prefix (absolute-position RoPE), so equal prefixes
+    mean equal pages.
+  * A sequence's writes always start at its first unshared position, which
+    by construction lands in a privately-owned block — shared blocks are
+    read-only for their entire lifetime, so reference counting alone is
+    sound; there is no "first divergent write" to copy on.
+  * The cache is an LRU over chain-hash keys: ``h_k = hash(h_{k-1},
+    block_k_tokens)``.  Lookup walks the query's chain from the longest
+    prefix down, so a hit reuses the longest cached prefix; eviction
+    decrefs, and blocks still referenced by live slots survive.
+
+Every diagnosis query shares the system preamble + evidence prefix
+(monitor/analysis.py builds them), so at 100 concurrent the prefix is
+prefilled once instead of 100 times — the reference has no inference at all
+to cache (its LLM layer is config keys, reference
+internal/config/config.go:141-145); this is a north-star obligation
+(SURVEY.md §7 hard parts #1/#2).
 """
 
 from __future__ import annotations
+
+import dataclasses
 
 
 class OutOfBlocks(Exception):
@@ -18,12 +40,20 @@ class OutOfBlocks(Exception):
 
 
 class BlockAllocator:
+    """Free-list allocator with per-block reference counts.
+
+    ``alloc``/``extend`` hand out blocks at refcount 1; ``incref`` adds
+    sharers; ``free`` decrements and returns a block to the free list only
+    when its count reaches zero.
+    """
+
     def __init__(self, num_blocks: int, block_size: int):
         if num_blocks < 2:
             raise ValueError("need at least 2 blocks (block 0 is reserved)")
         self.num_blocks = num_blocks
         self.block_size = block_size
         self._free: list[int] = list(range(num_blocks - 1, 0, -1))  # pop -> 1,2,...
+        self._refs: dict[int, int] = {}
 
     @property
     def free_blocks(self) -> int:
@@ -39,7 +69,10 @@ class BlockAllocator:
         n = self.blocks_for(num_tokens)
         if n > len(self._free):
             raise OutOfBlocks(f"need {n} blocks, {len(self._free)} free")
-        return [self._free.pop() for _ in range(n)]
+        out = [self._free.pop() for _ in range(n)]
+        for b in out:
+            self._refs[b] = 1
+        return out
 
     def extend(self, blocks: list[int], new_len: int) -> None:
         """Grow ``blocks`` in place to cover ``new_len`` tokens."""
@@ -49,11 +82,141 @@ class BlockAllocator:
         if need > len(self._free):
             raise OutOfBlocks(f"need {need} more blocks, {len(self._free)} free")
         for _ in range(need):
-            blocks.append(self._free.pop())
+            b = self._free.pop()
+            self._refs[b] = 1
+            blocks.append(b)
+
+    def incref(self, blocks: list[int]) -> None:
+        for b in blocks:
+            if b == 0:
+                raise ValueError("cannot share the null block")
+            self._refs[b] += 1
+
+    def ref_count(self, block: int) -> int:
+        return self._refs.get(block, 0)
 
     def free(self, blocks: list[int]) -> None:
         for b in blocks:
             if b == 0:
                 raise ValueError("attempt to free the null block")
-            self._free.append(b)
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                del self._refs[b]
+                self._free.append(b)
         blocks.clear()
+
+
+@dataclasses.dataclass
+class _PrefixEntry:
+    blocks: tuple[int, ...]     # cache-owned refs (one per block)
+    tokens: tuple[int, ...]     # the exact prefix (collision guard)
+    last_use: int               # LRU clock tick
+
+
+class PrefixCache:
+    """LRU map from token-prefix chain hashes to shared KV blocks.
+
+    All entries' blocks carry one cache-owned reference; ``lookup`` increfs
+    the reused span for the caller, ``evict_lru`` releases the cache's own
+    reference (live slots keep their pages).
+    """
+
+    def __init__(self, allocator: BlockAllocator, max_entries: int = 512):
+        self.allocator = allocator
+        self.max_entries = max_entries
+        self._entries: dict[int, _PrefixEntry] = {}
+        self._clock = 0
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def _chain_hashes(self, prompt_ids: list[int], n_blocks: int) -> list[int]:
+        bs = self.allocator.block_size
+        hashes = []
+        h = 0
+        for k in range(n_blocks):
+            h = hash((h, tuple(prompt_ids[k * bs:(k + 1) * bs])))
+            hashes.append(h)
+        return hashes
+
+    def _shareable_blocks(self, prompt_ids: list[int]) -> int:
+        """Full blocks covered by the prompt, leaving >= 1 unshared token
+        (the final prompt token must run through prefill to produce the
+        first-token logits)."""
+        bs = self.allocator.block_size
+        return min(len(prompt_ids) // bs, (len(prompt_ids) - 1) // bs)
+
+    def lookup(self, prompt_ids: list[int]) -> tuple[list[int], int]:
+        """Longest cached prefix of ``prompt_ids``.
+
+        Returns (shared block ids increfed for the caller, tokens covered).
+        The caller owns one reference per returned block and must release
+        it through ``BlockAllocator.free`` eventually.
+        """
+        n = self._shareable_blocks(prompt_ids)
+        if n <= 0 or not self._entries:
+            self.misses += 1
+            return [], 0
+        hashes = self._chain_hashes(prompt_ids, n)
+        bs = self.allocator.block_size
+        for k in range(n, 0, -1):
+            entry = self._entries.get(hashes[k - 1])
+            if (entry is not None and len(entry.blocks) >= k
+                    # Chain hashes index; exact tokens decide.  A hash
+                    # collision must never hand one request another
+                    # request's KV pages (wrong output + content leak).
+                    and entry.tokens == tuple(prompt_ids[:k * bs])):
+                self._clock += 1
+                entry.last_use = self._clock
+                shared = list(entry.blocks[:k])
+                self.allocator.incref(shared)
+                self.hits += 1
+                return shared, k * self.allocator.block_size
+        self.misses += 1
+        return [], 0
+
+    def register(self, prompt_ids: list[int], blocks: list[int]) -> None:
+        """Publish a prompt's full blocks for reuse (after its prefill has
+        been dispatched — page contents are ordered by device data flow).
+
+        One entry is stored per prefix length (a flattened trie), so a later
+        prompt diverging mid-way still reuses the longest common span.  Each
+        entry owns references on its own span; block i is held by every
+        entry covering it and returns to the pool when all are evicted."""
+        n = self._shareable_blocks(prompt_ids)
+        if n <= 0:
+            return
+        hashes = self._chain_hashes(prompt_ids, n)
+        bs = self.allocator.block_size
+        self._clock += 1
+        for k in range(n, 0, -1):
+            key = hashes[k - 1]
+            entry = self._entries.get(key)
+            if entry is not None:
+                entry.last_use = self._clock
+                continue
+            while len(self._entries) >= self.max_entries:
+                if not self.evict_lru():
+                    return
+            shared = blocks[:k]
+            self.allocator.incref(shared)
+            self._entries[key] = _PrefixEntry(
+                tuple(shared), tuple(prompt_ids[:k * bs]), self._clock)
+
+    def evict_lru(self) -> bool:
+        """Drop the least-recently-used entry (releasing the cache's block
+        references).  Returns False when the cache is empty."""
+        if not self._entries:
+            return False
+        key = min(self._entries, key=lambda k: self._entries[k].last_use)
+        entry = self._entries.pop(key)
+        self.allocator.free(list(entry.blocks))
+        self.evictions += 1
+        return True
+
+    def clear(self) -> None:
+        while self.evict_lru():
+            pass

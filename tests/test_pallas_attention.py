@@ -23,10 +23,11 @@ from k8s_llm_monitor_tpu.ops.pallas_attention import (
 def _random_paged_case(rng, B, H, KVH, D, num_blocks, bs, max_blocks):
     """Build a random paged-cache decode case with ragged lengths."""
     q = jnp.asarray(rng.standard_normal((B, 1, H, D)), jnp.float32)
+    # Fused lane layout [num_blocks, bs, KVH*D] — models/llama.py:KVPages.
     k_pages = jnp.asarray(
-        rng.standard_normal((num_blocks, bs, KVH, D)), jnp.float32)
+        rng.standard_normal((num_blocks, bs, KVH * D)), jnp.float32)
     v_pages = jnp.asarray(
-        rng.standard_normal((num_blocks, bs, KVH, D)), jnp.float32)
+        rng.standard_normal((num_blocks, bs, KVH * D)), jnp.float32)
 
     lengths = rng.integers(1, max_blocks * bs, size=(B,)).astype(np.int32)
     table = np.zeros((B, max_blocks), np.int32)
@@ -67,8 +68,8 @@ def test_kernel_inactive_lane_null_block():
     rng = np.random.default_rng(0)
     B, H, KVH, D, bs, max_blocks = 2, 8, 4, 64, 8, 4
     q = jnp.asarray(rng.standard_normal((B, 1, H, D)), jnp.float32)
-    kp = jnp.asarray(rng.standard_normal((10, bs, KVH, D)), jnp.float32)
-    vp = jnp.asarray(rng.standard_normal((10, bs, KVH, D)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((10, bs, KVH * D)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((10, bs, KVH * D)), jnp.float32)
     table = jnp.zeros((B, max_blocks), jnp.int32)
     lens = jnp.ones((B,), jnp.int32)
 

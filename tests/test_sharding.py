@@ -102,10 +102,12 @@ def test_70b_class_specs_divide_on_tp8_and_tp16():
 
         pages_shape = jax.eval_shape(
             lambda c=cfg: llama.init_kv_pages(c, 16, 16))
-        kv8 = kv_pages_partition_specs(pages_shape, _FakeMesh(8))
-        assert kv8.k[0] == P(None, None, "model", None)  # 8 kv heads / tp8
-        kv16 = kv_pages_partition_specs(pages_shape, _FakeMesh(16))
-        assert kv16.k[0] == P(None, None, None, None)    # tp16 > kv -> repl
+        kv8 = kv_pages_partition_specs(
+            pages_shape, _FakeMesh(8), num_kv_heads=cfg.num_kv_heads)
+        assert kv8.k[0] == P(None, None, "model")        # 8 kv heads / tp8
+        kv16 = kv_pages_partition_specs(
+            pages_shape, _FakeMesh(16), num_kv_heads=cfg.num_kv_heads)
+        assert kv16.k[0] == P(None, None, None)          # tp16 > kv -> repl
 
 
 def test_70b_dims_tp_forward_lowers(cpu_mesh_devices):
