@@ -12,7 +12,8 @@ Design notes (TPU-first, not a port):
                             logits.
       * ``decode_step``   — one-token step over the paged cache.
   - The paged KV cache is a pytree of per-layer page arrays
-    ``[num_blocks, block_size, kv_heads, head_dim]``.  Block id 0 is reserved
+    ``[num_blocks, block_size, kv_heads * head_dim]`` (fused lane layout —
+    see ``KVPages``).  Block id 0 is reserved
     as the null block: masked/inactive lanes scatter their writes there, which
     keeps every write shape-static without corrupting live sequences
     (serving/kv_cache.py never allocates block 0).

@@ -152,12 +152,58 @@ def paged_decode_attention(
     return decode_attention(q, k, v, lengths)
 
 
-def select_attn_impl(platform: str | None = None, cfg=None):
+def make_tp_paged_attention(mesh, cfg, interpret: bool = False):
+    """Pallas paged decode attention under a GSPMD mesh, via ``shard_map``.
+
+    Paged decode attention is embarrassingly tensor-parallel when the KV
+    pages shard on kv-head boundaries (parallel/sharding.py): every query
+    head's output depends only on its own kv group's pages, so each device
+    runs the kernel on its local head/page shard and NO collective is
+    needed — the sharded outputs are exactly the sharded o-projection
+    inputs.  Requires ``tp | num_kv_heads`` (the same condition under which
+    the pages shard at all); the block-diagonal GQA trick is per-kv-group
+    and group boundaries align with the shard cuts.
+
+    ``interpret`` runs the kernel in the Pallas interpreter per shard — the
+    CPU-mesh path used by tests and the driver's virtual-device dryrun.
+    """
+    import functools
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from k8s_llm_monitor_tpu.ops.pallas_attention import (
+        paged_decode_attention_pallas,
+    )
+
+    qspec = P(None, None, "model", None)       # query heads over TP
+    pspec = P(None, None, "model")             # fused kv lanes over TP
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(qspec, pspec, pspec, P(None, None), P(None)),
+        out_specs=qspec, check_rep=False)
+    def attn(q, k_pages, v_pages, block_table, lengths):
+        return paged_decode_attention_pallas(
+            q, k_pages, v_pages, block_table, lengths, interpret=interpret)
+
+    return attn
+
+
+def _pallas_geometry_ok(cfg, tp: int) -> bool:
+    """Mosaic lane-alignment gate for the (per-shard) fused page rows."""
+    fused_local = cfg.num_kv_heads * cfg.head_dim_ // tp
+    return fused_local % 128 == 0 and cfg.head_dim_ <= 128
+
+
+def select_attn_impl(platform: str | None = None, cfg=None, mesh=None):
     """Pick the paged-decode attention implementation for the backend.
 
-    TPU gets the Pallas kernel (block-table-driven HBM->VMEM streaming,
-    ops/pallas_attention.py); everything else (CPU tests, the virtual-device
-    dryrun) gets the XLA gather fallback above.
+    Single device on TPU gets the Pallas kernel (block-table-driven
+    HBM->VMEM streaming, ops/pallas_attention.py); a GSPMD ``mesh`` gets
+    the kernel wrapped in ``shard_map`` over the ``model`` axis (compiled
+    on TPU, interpreter on the CPU-mesh test/dryrun path); everything else
+    gets the XLA gather fallback above.
 
     ``cfg`` (a ModelConfig) gates on kernel geometry: the kernel DMAs pages
     as [block_size, kv_heads*head_dim] rows, and Mosaic requires that fused
@@ -170,17 +216,43 @@ def select_attn_impl(platform: str | None = None, cfg=None):
     logger = logging.getLogger("k8s_llm_monitor_tpu.ops")
     if platform is None:
         platform = jax.default_backend()
+
+    if mesh is not None:
+        tp = mesh.shape.get("model", 1)
+        if cfg is None or tp < 1 or cfg.num_kv_heads % tp != 0:
+            # Pages replicate in this regime (see kv_pages_partition_specs);
+            # the gather fallback partitions under GSPMD automatically.
+            if cfg is not None:
+                logger.warning(
+                    "TP=%d does not divide %d KV heads; paged attention "
+                    "uses the XLA gather fallback with replicated pages",
+                    tp, cfg.num_kv_heads)
+            return paged_decode_attention
+        interpret = platform != "tpu"
+        if not interpret and not _pallas_geometry_ok(cfg, tp):
+            logger.warning(
+                "Pallas kernel geometry gate failed for %s at TP=%d "
+                "(per-shard fused lanes not 128-aligned); using the XLA "
+                "gather fallback", getattr(cfg, "name", "model"), tp)
+            return paged_decode_attention
+        try:
+            return make_tp_paged_attention(mesh, cfg, interpret=interpret)
+        except Exception as exc:  # pragma: no cover
+            logger.warning(
+                "TP Pallas paged attention unavailable (%s); using the XLA "
+                "gather fallback", exc)
+            return paged_decode_attention
+
     if platform != "tpu":
         return paged_decode_attention
-    if cfg is not None:
-        fused = cfg.num_kv_heads * cfg.head_dim_
-        if fused % 128 != 0 or cfg.head_dim_ > 128:
-            logger.warning(
-                "Pallas paged-attention kernel unavailable for %s "
-                "(kv_heads*head_dim=%d not 128-aligned or head_dim>128); "
-                "using the XLA gather fallback — O(B*max_ctx) HBM traffic "
-                "per decode step", getattr(cfg, "name", "model"), fused)
-            return paged_decode_attention
+    if cfg is not None and not _pallas_geometry_ok(cfg, 1):
+        logger.warning(
+            "Pallas paged-attention kernel unavailable for %s "
+            "(kv_heads*head_dim=%d not 128-aligned or head_dim>128); "
+            "using the XLA gather fallback — O(B*max_ctx) HBM traffic "
+            "per decode step", getattr(cfg, "name", "model"),
+            cfg.num_kv_heads * cfg.head_dim_)
+        return paged_decode_attention
     try:
         from k8s_llm_monitor_tpu.ops.pallas_attention import (
             paged_decode_attention_pallas,

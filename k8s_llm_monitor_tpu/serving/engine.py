@@ -228,10 +228,11 @@ class InferenceEngine:
 
         if attn_impl is None:
             from k8s_llm_monitor_tpu.ops.attention import select_attn_impl
-            # The Pallas kernel is single-device; under a GSPMD mesh the
-            # XLA gather path partitions automatically, so keep it there.
-            attn_impl = select_attn_impl(
-                "cpu" if mesh is not None else None, cfg=cfg)
+            # Under a GSPMD mesh the kernel runs per-shard via shard_map
+            # (ops/attention.py:make_tp_paged_attention) when the KV heads
+            # divide the TP degree; otherwise the XLA gather path
+            # partitions automatically.
+            attn_impl = select_attn_impl(cfg=cfg, mesh=mesh)
         self._attn_impl = attn_impl
 
         def _prefill_sample_fn(params, tokens, lengths, pages, tables,
@@ -534,9 +535,21 @@ class InferenceEngine:
                         self.allocator.free(shared)
                     break
                 self._pending.popleft()
+                if self.prefix_cache is not None:
+                    if shared_toks > 0:
+                        self.prefix_cache.hits += 1
+                    else:
+                        self.prefix_cache.misses += 1
                 self._admit_long(req, free[0], shared, shared_toks)
                 return True
             self._pending.popleft()
+            if self.prefix_cache is not None:
+                # Stats count *admissions* (a deferred request's retried
+                # lookups must not double-count).
+                if shared_toks > 0:
+                    self.prefix_cache.hits += 1
+                else:
+                    self.prefix_cache.misses += 1
             blocks = shared + self.allocator.alloc(L + 1 - shared_toks)
             batch.append((free.pop(0), req, blocks, shared_toks))
         if not batch:

@@ -17,10 +17,15 @@ Prefix sharing design (TPU-first, no copy-on-write needed):
     by construction lands in a privately-owned block — shared blocks are
     read-only for their entire lifetime, so reference counting alone is
     sound; there is no "first divergent write" to copy on.
-  * The cache is an LRU over chain-hash keys: ``h_k = hash(h_{k-1},
-    block_k_tokens)``.  Lookup walks the query's chain from the longest
-    prefix down, so a hit reuses the longest cached prefix; eviction
-    decrefs, and blocks still referenced by live slots survive.
+  * The cache is an LRU over chain-hash keys: ``h_k = sha256(h_{k-1} ||
+    block_k_token_bytes)``.  SHA-256 chaining makes the key itself the
+    collision guard (Python's tuple hash is deterministic and adversarially
+    constructible; a collision here would hand one request another's KV
+    pages), and keeps registration O(L) — no per-entry token copies.
+    Lookup walks the query's chain from the longest prefix down, so a hit
+    reuses the longest cached prefix; eviction decrefs, and blocks still
+    referenced by live slots survive.  LRU order lives in dict insertion
+    order (touch = pop + reinsert), so eviction is O(1).
 
 Every diagnosis query shares the system preamble + evidence prefix
 (monitor/analysis.py builds them), so at 100 concurrent the prefix is
@@ -33,6 +38,9 @@ internal/config/config.go:141-145); this is a north-star obligation
 from __future__ import annotations
 
 import dataclasses
+import hashlib
+
+import numpy as np
 
 
 class OutOfBlocks(Exception):
@@ -109,23 +117,25 @@ class BlockAllocator:
 @dataclasses.dataclass
 class _PrefixEntry:
     blocks: tuple[int, ...]     # cache-owned refs (one per block)
-    tokens: tuple[int, ...]     # the exact prefix (collision guard)
-    last_use: int               # LRU clock tick
 
 
 class PrefixCache:
-    """LRU map from token-prefix chain hashes to shared KV blocks.
+    """LRU map from token-prefix chain digests to shared KV blocks.
 
     All entries' blocks carry one cache-owned reference; ``lookup`` increfs
     the reused span for the caller, ``evict_lru`` releases the cache's own
     reference (live slots keep their pages).
+
+    ``hits``/``misses`` are maintained by the engine at admission time (a
+    lookup retried for a deferred request must not double-count).
     """
 
     def __init__(self, allocator: BlockAllocator, max_entries: int = 512):
         self.allocator = allocator
         self.max_entries = max_entries
-        self._entries: dict[int, _PrefixEntry] = {}
-        self._clock = 0
+        # Insertion-ordered: first key is always the LRU entry (touch =
+        # pop + reinsert), so eviction never scans.
+        self._entries: dict[bytes, _PrefixEntry] = {}
         self.hits = 0
         self.misses = 0
         self.evictions = 0
@@ -133,14 +143,16 @@ class PrefixCache:
     def __len__(self) -> int:
         return len(self._entries)
 
-    def _chain_hashes(self, prompt_ids: list[int], n_blocks: int) -> list[int]:
+    def _chain_digests(self, prompt_ids: list[int], n_blocks: int) -> list[bytes]:
+        """SHA-256 chain over block token bytes: collision-proof keys, O(L)."""
         bs = self.allocator.block_size
-        hashes = []
-        h = 0
+        digests = []
+        h = b""
         for k in range(n_blocks):
-            h = hash((h, tuple(prompt_ids[k * bs:(k + 1) * bs])))
-            hashes.append(h)
-        return hashes
+            block = np.asarray(prompt_ids[k * bs:(k + 1) * bs], np.int64)
+            h = hashlib.sha256(h + block.tobytes()).digest()
+            digests.append(h)
+        return digests
 
     def _shareable_blocks(self, prompt_ids: list[int]) -> int:
         """Full blocks covered by the prompt, leaving >= 1 unshared token
@@ -148,6 +160,10 @@ class PrefixCache:
         first-token logits)."""
         bs = self.allocator.block_size
         return min(len(prompt_ids) // bs, (len(prompt_ids) - 1) // bs)
+
+    def _touch(self, key: bytes, entry: _PrefixEntry) -> None:
+        del self._entries[key]
+        self._entries[key] = entry
 
     def lookup(self, prompt_ids: list[int]) -> tuple[list[int], int]:
         """Longest cached prefix of ``prompt_ids``.
@@ -158,24 +174,15 @@ class PrefixCache:
         """
         n = self._shareable_blocks(prompt_ids)
         if n <= 0 or not self._entries:
-            self.misses += 1
             return [], 0
-        hashes = self._chain_hashes(prompt_ids, n)
-        bs = self.allocator.block_size
+        digests = self._chain_digests(prompt_ids, n)
         for k in range(n, 0, -1):
-            entry = self._entries.get(hashes[k - 1])
-            if (entry is not None and len(entry.blocks) >= k
-                    # Chain hashes index; exact tokens decide.  A hash
-                    # collision must never hand one request another
-                    # request's KV pages (wrong output + content leak).
-                    and entry.tokens == tuple(prompt_ids[:k * bs])):
-                self._clock += 1
-                entry.last_use = self._clock
+            entry = self._entries.get(digests[k - 1])
+            if entry is not None and len(entry.blocks) >= k:
+                self._touch(digests[k - 1], entry)
                 shared = list(entry.blocks[:k])
                 self.allocator.incref(shared)
-                self.hits += 1
                 return shared, k * self.allocator.block_size
-        self.misses += 1
         return [], 0
 
     def register(self, prompt_ids: list[int], blocks: list[int]) -> None:
@@ -189,29 +196,26 @@ class PrefixCache:
         n = self._shareable_blocks(prompt_ids)
         if n <= 0:
             return
-        hashes = self._chain_hashes(prompt_ids, n)
-        bs = self.allocator.block_size
-        self._clock += 1
+        digests = self._chain_digests(prompt_ids, n)
         for k in range(n, 0, -1):
-            key = hashes[k - 1]
+            key = digests[k - 1]
             entry = self._entries.get(key)
             if entry is not None:
-                entry.last_use = self._clock
+                self._touch(key, entry)
                 continue
             while len(self._entries) >= self.max_entries:
                 if not self.evict_lru():
                     return
             shared = blocks[:k]
             self.allocator.incref(shared)
-            self._entries[key] = _PrefixEntry(
-                tuple(shared), tuple(prompt_ids[:k * bs]), self._clock)
+            self._entries[key] = _PrefixEntry(tuple(shared))
 
     def evict_lru(self) -> bool:
         """Drop the least-recently-used entry (releasing the cache's block
         references).  Returns False when the cache is empty."""
         if not self._entries:
             return False
-        key = min(self._entries, key=lambda k: self._entries[k].last_use)
+        key = next(iter(self._entries))
         entry = self._entries.pop(key)
         self.allocator.free(list(entry.blocks))
         self.evictions += 1

@@ -98,7 +98,6 @@ def test_prefix_cache_lookup_longest_and_refcounts():
     # Fully different prompt: miss.
     shared, toks = pc.lookup([7] * 18)
     assert shared == [] and toks == 0
-    assert pc.hits == 2 and pc.misses == 1
 
 
 def test_prefix_cache_never_shares_whole_prompt():

@@ -132,3 +132,24 @@ def test_70b_dims_tp_forward_lowers(cpu_mesh_devices):
         lambda p, t: llama.forward_full(p, cfg, t)
     ).lower(shaped, tok_shape)
     assert "stablehlo" in lowered.as_text()[:4000].lower()
+
+
+def test_tp_engine_selects_pallas_kernel_path(cpu_mesh_devices):
+    """When TP divides the KV heads, the engine must run the shard_map-
+    wrapped Pallas kernel (VERDICT r3 item 3), not the gather fallback;
+    when it does not divide, it must fall back."""
+    from k8s_llm_monitor_tpu.ops.attention import paged_decode_attention
+
+    params = llama.init_params(jax.random.PRNGKey(1), CFG)
+    ecfg = EngineConfig(max_slots=2, num_blocks=32, block_size=8,
+                        max_blocks_per_seq=8, prefill_buckets=(16,))
+    mesh = create_mesh(MeshConfig(model=8))          # 8 kv heads / tp8
+    eng = InferenceEngine(CFG, params, ecfg, eos_id=-1, mesh=mesh)
+    assert eng._attn_impl is not paged_decode_attention
+
+    import dataclasses as _dc
+    cfg3 = _dc.replace(CFG, num_kv_heads=2, num_heads=8)  # tp8 > 2 kv heads
+    eng2 = InferenceEngine(
+        cfg3, llama.init_params(jax.random.PRNGKey(1), cfg3),
+        ecfg, eos_id=-1, mesh=mesh)
+    assert eng2._attn_impl is paged_decode_attention
