@@ -330,6 +330,7 @@ def main() -> None:
     # can't hide a slow chunk path.  Separate engine so bucket shapes and the
     # KV pool match the longer sequences.
     long_p50_ms = None  # omitted from the JSON if the leg doesn't complete
+    long_shared_p50_ms = None
     try:
         n_long = int(os.environ.get("BENCH_LONG_CONCURRENCY", "16"))
         long_len = int(os.environ.get("BENCH_LONG_PROMPT_LEN", "1536"))
@@ -348,7 +349,10 @@ def main() -> None:
         def long_prompt() -> list[int]:
             return list(rng.integers(4, cfg.vocab_size - 4, size=long_len))
 
-        leng.generate([long_prompt()], SamplingParams(max_tokens=16))  # warm
+        # Warm both chunk-round lane counts (P=1 and P=max) + decode.
+        leng.generate([long_prompt()], SamplingParams(max_tokens=16))
+        leng.generate([long_prompt() for _ in range(4)],
+                      SamplingParams(max_tokens=8))
         lt0 = time.monotonic()
         for i in range(n_long):
             leng.submit(GenerationRequest(
@@ -366,6 +370,30 @@ def main() -> None:
             np.array(sorted(r.ttft_s for r in lres)), 50)) * 1e3
         log(f"long prompts ({long_len} tok x {n_long}): p50 TTFT "
             f"{long_p50_ms:.1f} ms, drained in {lwall:.2f}s")
+
+        # Shared-prefix long prompts: the realistic long-diagnosis shape
+        # (shared evidence prefix + per-query tail) through the chunked
+        # admission path with prefix reuse.
+        shared_long = long_prompt()[: long_len - 256]
+        def sl_prompt() -> list[int]:
+            return shared_long + list(rng.integers(
+                4, cfg.vocab_size - 4, size=256))
+        leng.generate([sl_prompt()], SamplingParams(max_tokens=4))  # seed
+        st = time.monotonic()
+        for i in range(n_long):
+            leng.submit(GenerationRequest(
+                request_id=f"sl-{i}", prompt_ids=sl_prompt(),
+                sampling=SamplingParams(max_tokens=max_tokens)))
+        while leng.has_work:
+            leng.step()
+        slres = [leng.poll(f"sl-{i}") for i in range(n_long)]
+        assert all(r is not None and r.finish_reason != "error"
+                   for r in slres)
+        long_shared_p50_ms = float(np.percentile(
+            np.array(sorted(r.ttft_s for r in slres)), 50)) * 1e3
+        log(f"shared-prefix long prompts: p50 TTFT "
+            f"{long_shared_p50_ms:.1f} ms, drained in "
+            f"{time.monotonic() - st:.2f}s")
         del leng
     except Exception as exc:  # noqa: BLE001 — extras never fail the bench
         log(f"long-prompt bench skipped: {exc}")
@@ -427,6 +455,8 @@ def main() -> None:
         extras["decode_bw_util"] = round(decode_bw_util, 3)
     if long_p50_ms is not None:  # 0.0 would read as a perfect score
         extras["long_prompt_p50_ttft_ms"] = round(long_p50_ms, 2)
+    if long_shared_p50_ms is not None:
+        extras["long_shared_prefix_p50_ttft_ms"] = round(long_shared_p50_ms, 2)
     log(f"total bench time {time.monotonic() - t0:.0f}s")
     print(json.dumps({
         "metric": "p50_ttft_100c_ms",

@@ -25,8 +25,12 @@ diagnosis queries, BASELINE.md):
     device->host latency that would otherwise serialize every step — on a
     remote-tunneled chip that latency is the dominant cost, and on a local
     chip it still buys dispatch/compute overlap.
-  * Prompts longer than the largest bucket go through chunked prefill
-    (continuation chunks attend to the paged prefix).
+  * Prompts longer than the largest bucket admit into *prefilling* slots:
+    their chunks stream one batched round per scheduler step (depth-first —
+    lanes closest to completion go first), so decode dispatches and
+    short-prompt admissions interleave between chunk rounds instead of
+    stalling behind a serial per-request chunk loop.  Continuation chunks
+    attend to the paged prefix.
 
 Speculation note: EOS is only learned at reconcile time, so up to
 ``max_inflight`` decode calls may keep stepping a finished lane.  Those
@@ -122,7 +126,8 @@ class EngineConfig:
 class _Slot:
     __slots__ = ("req", "blocks", "ctx_len", "generated", "pending_admit",
                  "inflight_decode", "first_token_time", "retired",
-                 "cancel_requested")
+                 "cancel_requested", "prefill_pos", "prefilling",
+                 "inflight_chunks")
 
     def __init__(self, req: GenerationRequest, blocks: list[int]):
         self.req = req
@@ -134,6 +139,11 @@ class _Slot:
         self.first_token_time = 0.0
         self.retired = False
         self.cancel_requested = False
+        # Long-prompt streaming admission: tokens dispatched so far and
+        # whether more chunks remain (decode skips prefilling slots).
+        self.prefill_pos = 0
+        self.prefilling = False
+        self.inflight_chunks = 0         # chunk calls dispatched, unreconciled
 
     # -- predicted (dispatch-side) state --------------------------------
 
@@ -153,11 +163,14 @@ class _Slot:
 
 @dataclasses.dataclass
 class _Inflight:
-    kind: str                     # "admit" | "decode"
+    kind: str                     # "admit" | "chunk" | "decode"
     call_id: int
     arr: Any                      # device array (async copy started)
-    # admit: [(slot_idx, req)]; decode: [(slot_idx, steps_i)]
+    # admit: [(slot_idx, req)]; chunk: [(row, slot_idx, req)] final lanes;
+    # decode: [(slot_idx, slot, steps_i)]
     lanes: list[tuple]
+    # chunk: every slot touched by the call (inflight_chunks decrement).
+    touched: list = dataclasses.field(default_factory=list)
 
 
 # Sink signature: (request_id, new_token_ids, result_or_none).  ``result`` is
@@ -254,11 +267,6 @@ class InferenceEngine:
             )
             return greedy_tokens(logits), pages
 
-        def _prefill_chunk_fn(params, tokens, start, lengths, pages, tables):
-            return llama.prefill_chunk(
-                params, cfg, tokens, start, lengths, pages, tables
-            )
-
         def _prefill_chunk_sample_fn(params, tokens, start, lengths, pages,
                                      tables, temp, topk, topp, rng):
             # Batched admission over cached prefixes: each lane ingests only
@@ -287,17 +295,11 @@ class InferenceEngine:
         # pages are donated so the scatter-updates happen in place on device.
         self._prefill_sample = jax.jit(_prefill_sample_fn, donate_argnums=(3,))
         self._prefill_greedy = jax.jit(_prefill_greedy_fn, donate_argnums=(3,))
-        self._prefill_chunk = jax.jit(_prefill_chunk_fn, donate_argnums=(4,))
         self._prefill_chunk_sample = jax.jit(
             _prefill_chunk_sample_fn, donate_argnums=(4,))
         self._prefill_chunk_greedy = jax.jit(
             _prefill_chunk_greedy_fn, donate_argnums=(4,))
         self._place_tokens = jax.jit(_place_fn, donate_argnums=(0,))
-        self._sample = jax.jit(
-            lambda rng, logits, t, k, p: sample_tokens(
-                rng, logits, temperature=t, top_k=k, top_p=p
-            )
-        )
         # Fused-decode programs, built lazily per (n_steps, sampled).
         self._decode_cache: dict[tuple[int, bool], Any] = {}
 
@@ -431,6 +433,8 @@ class InferenceEngine:
         while rounds < self.ecfg.max_admission_rounds and self._admit_round():
             rounds += 1
             dispatched += 1
+        if self._dispatch_prefill_chunks():
+            dispatched += 1
         if self._dispatch_decode():
             dispatched += 1
         if dispatched:
@@ -505,6 +509,7 @@ class InferenceEngine:
         ec = self.ecfg
         top = ec.prefill_buckets[-1]
         free = self._free_slots()
+        admitted_long = 0
         # Entries: (slot_idx, req, blocks, shared_toks)
         batch: list[tuple[int, GenerationRequest, list[int], int]] = []
         while len(batch) < ec.max_prefills_per_step and self._pending and free:
@@ -526,22 +531,6 @@ class InferenceEngine:
                 if shared:
                     self.allocator.free(shared)
                 break
-            if L - shared_toks > top:
-                # Long suffix: serial chunked admission, alone in its round
-                # (the chunk loop runs per-request; batching short prompts
-                # around it would hold their first tokens hostage).
-                if batch:
-                    if shared:
-                        self.allocator.free(shared)
-                    break
-                self._pending.popleft()
-                if self.prefix_cache is not None:
-                    if shared_toks > 0:
-                        self.prefix_cache.hits += 1
-                    else:
-                        self.prefix_cache.misses += 1
-                self._admit_long(req, free[0], shared, shared_toks)
-                return True
             self._pending.popleft()
             if self.prefix_cache is not None:
                 # Stats count *admissions* (a deferred request's retried
@@ -550,10 +539,25 @@ class InferenceEngine:
                     self.prefix_cache.hits += 1
                 else:
                     self.prefix_cache.misses += 1
+            if req.orig_prompt_len < 0:
+                req.orig_prompt_len = L
             blocks = shared + self.allocator.alloc(L + 1 - shared_toks)
+            if L - shared_toks > top:
+                # Long suffix: occupy a slot in *prefilling* state — its
+                # chunks stream one batched round per engine step
+                # (_dispatch_prefill_chunks), so decode and short-prompt
+                # admissions interleave instead of stalling behind a
+                # serial chunk loop.
+                slot = _Slot(req, blocks)
+                slot.ctx_len = L
+                slot.prefill_pos = shared_toks
+                slot.prefilling = True
+                self._slots[free.pop(0)] = slot
+                admitted_long += 1
+                continue
             batch.append((free.pop(0), req, blocks, shared_toks))
         if not batch:
-            return False
+            return admitted_long > 0
 
         # Fixed lane counts (1 or the max) keep the compile cache small.
         P = 1 if len(batch) == 1 else ec.max_prefills_per_step
@@ -616,76 +620,101 @@ class InferenceEngine:
             first, [(s, r, b) for s, r, b, _ in batch], idx)
         return True
 
-    def _admit_long(self, req: GenerationRequest, slot_idx: int,
-                    shared: list[int] | None = None,
-                    shared_toks: int = 0) -> None:
-        """Chunked prefill for prompts whose unshared suffix exceeds the
-        largest bucket: the first chunk runs the dense path (when nothing is
-        cached), continuations attend to the paged prefix
-        (llama.prefill_chunk).  A prefix-cache hit skips straight to the
-        chunk loop at ``shared_toks``."""
+    def _dispatch_prefill_chunks(self) -> bool:
+        """One batched chunk round for slots in prefilling state.
+
+        Lanes are ordered depth-first (fewest remaining tokens first, then
+        submit order): finishing a few lanes completely beats advancing all
+        of them one chunk — p50 TTFT is completion-order-sensitive while
+        total work is fixed.  Each lane ingests its next ``<= top`` tokens
+        via the per-lane-start chunked program; lanes whose chunk is final
+        sample their first token in the same call (admit semantics at
+        reconcile), non-final lanes drop theirs.  One round per engine
+        step, so decode dispatches interleave between rounds.
+        """
         ec = self.ecfg
-        L = len(req.prompt_ids)
-        if req.orig_prompt_len < 0:
-            req.orig_prompt_len = L
-        blocks = (shared or []) + self.allocator.alloc(L + 1 - shared_toks)
-        table = np.zeros((1, ec.max_blocks_per_seq), np.int32)
-        table[0, : len(blocks)] = blocks
-        table_j = jnp.asarray(table)
-
         top = ec.prefill_buckets[-1]
-        sp = req.sampling
-        self._rng, sub = jax.random.split(self._rng)
+        cands = [(i, s) for i, s in enumerate(self._slots)
+                 if s is not None and s.prefilling and not s.retired
+                 and not s.cancel_requested]
+        if not cands:
+            return False
+        cands.sort(key=lambda t: (len(t[1].req.prompt_ids)
+                                  - t[1].prefill_pos,
+                                  t[1].req.submit_time))
+        cands = cands[:ec.max_prefills_per_step]
 
-        pos = shared_toks
-        if pos == 0:
-            # First chunk (dense path); its sampled token is discarded —
-            # only the final chunk's logits matter.
-            tokens = np.zeros((1, top), np.int32)
-            tokens[0, :top] = req.prompt_ids[:top]
-            _, self.pages = self._prefill_sample(
-                self.params, jnp.asarray(tokens),
-                jnp.asarray([top], jnp.int32), self.pages, table_j,
-                jnp.asarray([0.0], jnp.float32),
-                jnp.asarray([0], jnp.int32),
-                jnp.asarray([1.0], jnp.float32), sub,
-            )
-            pos = top
-        logits = None
-        while pos < L:
-            n = min(L - pos, top)
-            bucket = self._bucket(n)
-            tokens = np.zeros((1, bucket), np.int32)
-            tokens[0, :n] = req.prompt_ids[pos:pos + n]
-            logits, self.pages = self._prefill_chunk(
-                self.params, jnp.asarray(tokens),
-                jnp.asarray([pos], jnp.int32), jnp.asarray([n], jnp.int32),
-                self.pages, table_j,
-            )
-            pos += n
-        self._rng, sub = jax.random.split(self._rng)
-        first = self._sample(
-            sub, logits,
-            jnp.asarray([sp.temperature], jnp.float32),
-            jnp.asarray([sp.top_k], jnp.int32),
-            jnp.asarray([sp.top_p], jnp.float32),
-        )
-        if self.prefix_cache is not None:
-            self.prefix_cache.register(req.prompt_ids, blocks)
-        self._finish_admit_dispatch(
-            first, [(slot_idx, req, blocks)],
-            np.asarray([slot_idx], np.int32))
+        P = 1 if len(cands) == 1 else ec.max_prefills_per_step
+        bucket = self._bucket(min(top, max(
+            len(s.req.prompt_ids) - s.prefill_pos for _, s in cands)))
+        tokens = np.zeros((P, bucket), np.int32)
+        start = np.zeros((P,), np.int32)
+        lengths = np.zeros((P,), np.int32)
+        tables = np.zeros((P, ec.max_blocks_per_seq), np.int32)
+        idx = np.full((P,), ec.max_slots, np.int32)   # drop unless final
+        temp = np.zeros((P,), np.float32)
+        topk = np.zeros((P,), np.int32)
+        topp = np.ones((P,), np.float32)
+        lanes: list[tuple] = []
+        touched: list[_Slot] = []
+        final_greedy = True
+        for j, (i, s) in enumerate(cands):
+            L = len(s.req.prompt_ids)
+            n = min(bucket, L - s.prefill_pos)
+            tokens[j, :n] = s.req.prompt_ids[s.prefill_pos:s.prefill_pos + n]
+            start[j] = s.prefill_pos
+            lengths[j] = n
+            tables[j, : len(s.blocks)] = s.blocks
+            s.prefill_pos += n
+            s.inflight_chunks += 1
+            touched.append(s)
+            if s.prefill_pos >= L:
+                # Final chunk: its last-token logits produce the first
+                # generated token; pages for the whole prompt are now in
+                # the dispatch chain, so the prefix becomes publishable.
+                s.prefilling = False
+                sp = s.req.sampling
+                temp[j], topk[j], topp[j] = sp.temperature, sp.top_k, sp.top_p
+                final_greedy = final_greedy and sp.temperature <= 0.0
+                idx[j] = i
+                lanes.append((j, i, s.req))
+                if self.prefix_cache is not None:
+                    self.prefix_cache.register(s.req.prompt_ids, s.blocks)
 
-    def _finish_admit_dispatch(self, first, batch, idx) -> None:
-        """Shared tail of both admission paths: place first tokens into the
-        device token buffer, start the async host copy, occupy slots, and
-        queue the reconcile entry."""
+        if final_greedy:
+            first, self.pages = self._prefill_chunk_greedy(
+                self.params, jnp.asarray(tokens), jnp.asarray(start),
+                jnp.asarray(lengths), self.pages, jnp.asarray(tables),
+            )
+        else:
+            self._rng, sub = jax.random.split(self._rng)
+            first, self.pages = self._prefill_chunk_sample(
+                self.params, jnp.asarray(tokens), jnp.asarray(start),
+                jnp.asarray(lengths), self.pages, jnp.asarray(tables),
+                jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
+                sub,
+            )
+        self.prefills += len(lanes)
+        self._queue_inflight("chunk", first, idx, lanes, touched)
+        return True
+
+    def _queue_inflight(self, kind: str, first, idx, lanes,
+                        touched=()) -> None:
+        """Shared dispatch tail: place sampled tokens into the device token
+        buffer, start the async host copy, and queue the reconcile entry."""
         self._tok_state = self._place_tokens(
             self._tok_state, first, jnp.asarray(idx))
         try:
             first.copy_to_host_async()
         except AttributeError:  # non-jax array (tests with stub impls)
             pass
+        self._inflight.append(_Inflight(
+            kind=kind, call_id=self._next_call_id, arr=first,
+            lanes=list(lanes), touched=list(touched)))
+        self._next_call_id += 1
+
+    def _finish_admit_dispatch(self, first, batch, idx) -> None:
+        """Admission tail: occupy slots, then queue via the shared path."""
         lanes = []
         for slot_idx, req, blocks in batch:
             slot = _Slot(req, blocks)
@@ -693,9 +722,7 @@ class InferenceEngine:
             self._slots[slot_idx] = slot
             lanes.append((slot_idx, req))
         self.prefills += len(batch)
-        self._inflight.append(_Inflight(
-            kind="admit", call_id=self._next_call_id, arr=first, lanes=lanes))
-        self._next_call_id += 1
+        self._queue_inflight("admit", first, idx, lanes)
 
     # -- decode ---------------------------------------------------------
 
@@ -785,14 +812,18 @@ class InferenceEngine:
 
         # Retire cancelled lanes that have fully settled; exclude the rest
         # from new dispatches (their in-flight steps drain via reconcile).
+        # A cancelled slot still mid-prefill (prefilling) never reaches the
+        # admit reconcile that clears pending_admit, so it settles once its
+        # chunk calls drain.
         for i, s in enumerate(self._slots):
             if (s is not None and s.cancel_requested
-                    and not s.pending_admit and s.inflight_decode == 0):
+                    and s.inflight_decode == 0 and s.inflight_chunks == 0
+                    and (s.prefilling or not s.pending_admit)):
                 self._retire(i)
 
         lanes = [(i, s) for i, s in enumerate(self._slots)
                  if s is not None and s.remaining_pred > 0
-                 and not s.cancel_requested]
+                 and not s.prefilling and not s.cancel_requested]
         if not lanes:
             return False
 
@@ -837,7 +868,7 @@ class InferenceEngine:
                             break
 
         lanes = [(i, s) for i, s in enumerate(self._slots)
-                 if s is not None and not s.retired
+                 if s is not None and not s.retired and not s.prefilling
                  and s.remaining_pred > 0 and not s.cancel_requested]
         if not lanes:
             return False
@@ -893,9 +924,14 @@ class InferenceEngine:
     def _reconcile_one(self) -> None:
         call = self._inflight.popleft()
         arr = np.asarray(call.arr)
-        if call.kind == "admit":
+        if call.kind in ("admit", "chunk"):
             now = time.monotonic()
-            for j, (slot_idx, req) in enumerate(call.lanes):
+            for s in call.touched:           # chunk calls: drain refcounts
+                s.inflight_chunks -= 1
+            rows = (enumerate(call.lanes) if call.kind == "admit"
+                    else ((row, (slot_idx, req))
+                          for row, slot_idx, req in call.lanes))
+            for j, (slot_idx, req) in rows:
                 s = self._slots[slot_idx]
                 if s is None or s.req is not req:
                     continue  # preempted before reconcile
@@ -961,7 +997,9 @@ class InferenceEngine:
             request_id=s.req.request_id,
             token_ids=toks,
             finish_reason=reason,
-            ttft_s=s.first_token_time - s.req.submit_time,
+            # A slot cancelled mid-prefill retires with no first token.
+            ttft_s=(s.first_token_time - s.req.submit_time
+                    if s.first_token_time > 0.0 else 0.0),
             latency_s=now - s.req.submit_time,
         )
         self._results[s.req.request_id] = result
@@ -983,7 +1021,8 @@ class InferenceEngine:
         Only called on reconciled state (_dispatch_decode drains in-flight
         work before preempting), so ``generated`` is complete."""
         s = self._slots[slot_idx]
-        assert s is not None and s.inflight_decode == 0
+        assert (s is not None and s.inflight_decode == 0
+                and s.inflight_chunks == 0)
         self.allocator.free(s.blocks)
         self._slots[slot_idx] = None
         s.retired = True

@@ -177,3 +177,50 @@ def test_submit_poll_async_api(params):
     ra, rb = eng.poll("a"), eng.poll("b")
     assert ra is not None and rb is not None
     assert len(ra.token_ids) == 4 and len(rb.token_ids) == 4
+
+
+def test_long_prompts_stream_and_batch_chunks(params):
+    """Several long prompts admitted together stream their chunks in
+    batched rounds (depth-first) while a short prompt co-admits and
+    decodes between rounds; every output must still match naive decoding."""
+    eng = InferenceEngine(
+        CFG, params,
+        EngineConfig(max_slots=4, num_blocks=128, block_size=8,
+                     max_blocks_per_seq=16, prefill_buckets=(16,),
+                     max_prefills_per_step=4),
+        eos_id=-1,
+    )
+    rng = np.random.default_rng(7)
+    longs = [list(rng.integers(3, 300, size=n)) for n in (50, 60, 44)]
+    short = list(rng.integers(3, 300, size=6))
+    prompts = longs + [short]
+    results = eng.generate(prompts, SamplingParams(max_tokens=5))
+    for p, r in zip(prompts, results):
+        assert r.finish_reason == "length"
+        assert r.token_ids == _naive_greedy(params, p, 5)
+
+
+def test_cancel_mid_prefill_settles_cleanly(params):
+    """Cancelling a long prompt while its chunks are still streaming must
+    retire the slot, free its pages, and report an eos/length-free result
+    without a first token."""
+    eng = InferenceEngine(
+        CFG, params,
+        EngineConfig(max_slots=2, num_blocks=64, block_size=8,
+                     max_blocks_per_seq=16, prefill_buckets=(16,)),
+        eos_id=-1,
+    )
+    rng = np.random.default_rng(8)
+    long_prompt = list(rng.integers(3, 300, size=60))
+    from k8s_llm_monitor_tpu.serving.engine import GenerationRequest
+    eng.submit(GenerationRequest("lp", long_prompt,
+                                 SamplingParams(max_tokens=5)))
+    eng.step()                       # admit + first chunk round
+    assert any(s is not None and s.prefilling for s in eng._slots)
+    assert eng.cancel("lp")
+    while eng.has_work:
+        eng.step()
+    res = eng.poll("lp")
+    assert res is not None and res.token_ids == []
+    assert res.ttft_s == 0.0
+    assert eng.allocator.free_blocks == eng.allocator.num_blocks - 1
