@@ -36,6 +36,8 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--ring-attention", action="store_true",
                         help="explicit ring attention over the seq axis")
     parser.add_argument("--data", default="", help="flat int32 token .npy")
+    parser.add_argument("--seed", type=int, default=0,
+                        help="data-stream seed (offset by resumed step)")
     parser.add_argument("--ckpt-dir", default="")
     parser.add_argument("--ckpt-every", type=int, default=50)
     parser.add_argument("--resume", default="", help="checkpoint to restore")
@@ -109,7 +111,10 @@ def main(argv: list[str] | None = None) -> int:
     from jax.sharding import NamedSharding
 
     sharding = NamedSharding(mesh, data_spec())
-    rng = np.random.default_rng(0)
+    # Seed the data stream with the restored step: a resumed run continues
+    # the stream instead of replaying the batch windows already trained on
+    # (advisor r3).
+    rng = np.random.default_rng(args.seed + step0)
     B, S = args.batch, args.seq_len
 
     def next_batch() -> jax.Array:

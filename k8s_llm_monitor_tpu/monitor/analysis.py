@@ -138,14 +138,25 @@ class LocalEngineBackend(LLMBackend):
         from k8s_llm_monitor_tpu.utils.tokenizer import load_tokenizer
 
         dev_weights = not tpu_cfg.checkpoint
+        quantize = getattr(tpu_cfg, "quantize", "") == "int8"
         if tpu_cfg.checkpoint:
             from k8s_llm_monitor_tpu.utils.checkpoint import load_hf_checkpoint
 
-            cfg, params = load_hf_checkpoint(tpu_cfg.checkpoint)
+            # int8 streams each tensor through host-side quantization — the
+            # only way 8B-class checkpoints fit a 16 GB chip (utils/quantize).
+            cfg, params = load_hf_checkpoint(tpu_cfg.checkpoint,
+                                             quantize=quantize)
             tokenizer = load_tokenizer(tpu_cfg.checkpoint)
         else:
             cfg = PRESETS[tpu_cfg.model]
-            params = llama.init_params(jax.random.PRNGKey(0), cfg)
+            if quantize:
+                from k8s_llm_monitor_tpu.utils.quantize import (
+                    init_params_quantized,
+                )
+
+                params = init_params_quantized(jax.random.PRNGKey(0), cfg)
+            else:
+                params = llama.init_params(jax.random.PRNGKey(0), cfg)
             tokenizer = load_tokenizer(None)
 
         mesh = None

@@ -358,6 +358,13 @@ class Client:
             "last_update": rfc3339(report.timestamp or utcnow()),
             "collection_status": report.status or "active",
         }
+        if report.heartbeat_interval_seconds > 0:
+            # Advertised cadence: lets the scheduler judge staleness
+            # (monitor/scheduler.py) instead of trusting a frozen
+            # "active" status — the reference parses the heartbeat but
+            # never uses it (controller.go:202-203, SURVEY §2.7 soft spot).
+            status_payload["heartbeat_interval_seconds"] = (
+                report.heartbeat_interval_seconds)
         labels: dict[str, Any] = {
             "app": "uav-agent",
             "monitoring.io/component": "uav-metrics",

@@ -43,9 +43,13 @@ class TPULLMConfig:
 
     model: str = "llama-1b"  # preset name in models/config.py PRESETS
     checkpoint: str = ""  # HF checkpoint dir ('' => random-init dev weights)
+    quantize: str = ""  # "int8" = weight-only quantization (utils/quantize.py)
     mesh_shape: str = ""  # e.g. "1,1,8" for data,seq,model; '' => single chip
     max_batch: int = 32
     kv_blocks: int = 512
+    # Persistent XLA compilation cache: warm server restarts skip the
+    # multi-minute prefill/decode compile ladder.  '' disables.
+    compile_cache_dir: str = ".jax_cache"
 
 
 @dataclass

@@ -37,6 +37,7 @@ import ssl
 import struct
 import tempfile
 import threading
+import time
 import urllib.error
 import urllib.parse
 import urllib.request
@@ -87,9 +88,11 @@ def ws_accept_key(key: str) -> str:
         hashlib.sha1((key + magic).encode()).digest()).decode()
 
 
-def ws_encode_frame(opcode: int, payload: bytes, mask: bool) -> bytes:
-    """Encode one (FIN) websocket frame.  Client→server frames are masked."""
-    head = bytes([0x80 | opcode])
+def ws_encode_frame(opcode: int, payload: bytes, mask: bool,
+                    fin: bool = True) -> bytes:
+    """Encode one websocket frame.  Client→server frames are masked;
+    ``fin=False`` starts a fragmented message (continuations use opcode 0)."""
+    head = bytes([(0x80 if fin else 0x00) | opcode])
     n = len(payload)
     mask_bit = 0x80 if mask else 0
     if n < 126:
@@ -118,12 +121,15 @@ def _read_exact(rfile, n: int) -> bytes:
     return buf
 
 
-def ws_read_frame(rfile) -> tuple[int, bytes] | None:
-    """Read one frame; returns (opcode, payload), or None on clean EOF or a
-    close frame.  Raises ClusterError if the stream dies mid-frame."""
+def ws_read_frame(rfile) -> tuple[bool, int, bytes] | None:
+    """Read one frame; returns (fin, opcode, payload), or None on clean EOF
+    or a close frame.  Raises ClusterError if the stream dies mid-frame.
+    ``fin=False``/opcode 0 frames are fragments of one logical message —
+    the caller reassembles (exec_in_pod)."""
     head = rfile.read(2)
     if len(head) < 2:
         return None
+    fin = bool(head[0] & 0x80)
     opcode = head[0] & 0x0F
     masked = head[1] & 0x80
     n = head[1] & 0x7F
@@ -137,7 +143,7 @@ def ws_read_frame(rfile) -> tuple[int, bytes] | None:
         payload = bytes(b ^ key[i % 4] for i, b in enumerate(payload))
     if opcode == 0x8:  # close
         return None
-    return opcode, payload
+    return fin, opcode, payload
 
 
 # ---------------------------------------------------------------------------
@@ -461,21 +467,59 @@ class KubeRestBackend(ClusterBackend):
                 while rfile.readline().strip():
                     pass
                 raise ClusterError(f"exec upgrade refused: {status.strip()}")
-            while rfile.readline().strip():
-                pass  # skip response headers
-
-            stdout, stderr, status_json = b"", b"", b""
+            accept_hdr = ""
             while True:
+                line = rfile.readline().strip()
+                if not line:
+                    break  # end of response headers
+                name, _, value = line.decode(errors="replace").partition(":")
+                if name.strip().lower() == "sec-websocket-accept":
+                    accept_hdr = value.strip()
+            if accept_hdr != ws_accept_key(key):
+                # RFC 6455 makes the header mandatory: absent or wrong both
+                # mean we are NOT talking to the websocket peer we keyed.
+                raise ClusterError(
+                    "exec upgrade failed: Sec-WebSocket-Accept "
+                    f"{'missing' if not accept_hdr else 'mismatch'} "
+                    "(not a websocket peer or a tampering intermediary)")
+
+            # Overall wall-clock deadline: the per-read socket timeout only
+            # bounds silence — a command streaming slowly forever would
+            # otherwise hold the call open indefinitely.
+            deadline = time.monotonic() + timeout
+            stdout, stderr, status_json = b"", b"", b""
+            frag = b""            # partial fragmented message
+            fragmenting = False
+            while True:
+                if time.monotonic() > deadline:
+                    raise ClusterError(
+                        f"exec timed out after {timeout:.0f}s (output still "
+                        f"streaming)")
                 frame = ws_read_frame(rfile)
                 if frame is None:
                     break
-                opcode, payload = frame
+                fin, opcode, payload = frame
                 if opcode == 0x9:  # ping -> pong
                     sock.sendall(ws_encode_frame(0xA, payload, mask=True))
                     continue
-                if not payload:
+                # Reassemble fragmented messages before demuxing: the k8s
+                # channel id is the first byte of the *message*, which may
+                # arrive in any fragment (even an empty first frame).
+                if opcode == 0x0:
+                    if not fragmenting:
+                        continue  # stray continuation
+                    frag += payload
+                    if not fin:
+                        continue
+                    msg, frag, fragmenting = frag, b"", False
+                elif not fin:
+                    frag, fragmenting = payload, True
                     continue
-                channel, data = payload[0], payload[1:]
+                else:
+                    msg = payload
+                if not msg:
+                    continue
+                channel, data = msg[0], msg[1:]
                 if channel == 1:
                     stdout += data
                 elif channel == 2:

@@ -44,6 +44,14 @@ PREFERRED_NODE_BONUS = 10.0  # ref controller.go:205-208
 class SchedulerConfig:
     interval: float = 15.0  # ref cmd/scheduler/main.go:24 default
     tpu_node_bonus: float = 5.0  # extension: prefer TPU-carrying nodes
+    # Staleness gate (fixes the reference's soft spot, controller.go:202-203:
+    # heartbeat parsed but never used — a dead UAV with a fresh-looking CR
+    # could win placement).  A candidate is excluded when its last_update is
+    # older than ``stale_heartbeat_factor`` x its advertised heartbeat
+    # interval, or older than ``stale_after_seconds`` when no interval is
+    # advertised.  <= 0 disables either gate.
+    stale_heartbeat_factor: float = 3.0
+    stale_after_seconds: float = 120.0
 
 
 class SchedulerController:
@@ -188,6 +196,11 @@ class SchedulerController:
                 continue
             if min_battery > 0 and battery < min_battery:
                 continue
+            last = parse_rfc3339(status.get("last_update"))
+            if last is not None and self._is_stale(
+                last, float(status.get("heartbeat_interval_seconds") or 0.0)
+            ):
+                continue
             score = battery
             if node.lower() in preferred:
                 score += PREFERRED_NODE_BONUS
@@ -203,6 +216,15 @@ class SchedulerController:
                 )
             )
         return out
+
+    def _is_stale(self, last_update, heartbeat_s: float) -> bool:
+        """True when a CR's last_update is too old to trust its status."""
+        age = (utcnow() - last_update).total_seconds()
+        if heartbeat_s > 0 and self.cfg.stale_heartbeat_factor > 0:
+            return age > self.cfg.stale_heartbeat_factor * heartbeat_s
+        if self.cfg.stale_after_seconds > 0:
+            return age > self.cfg.stale_after_seconds
+        return False
 
     def _tpu_nodes(self) -> set[str]:
         try:

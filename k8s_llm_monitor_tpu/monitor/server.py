@@ -18,6 +18,7 @@ from __future__ import annotations
 import json
 import logging
 import mimetypes
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from pathlib import Path
@@ -263,7 +264,15 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
             except ValueError:
                 return self._send_error_text("Invalid JSON body", 400)
             seconds = min(float(body.get("seconds", 2.0)), 60.0)
-            trace_dir = body.get("dir") or "/tmp/k8s-llm-monitor-trace"
+            # "dir" is a subdirectory NAME under the trace root, never an
+            # arbitrary filesystem path (debug-gated but unauthenticated —
+            # advisor r3).
+            root = "/tmp/k8s-llm-monitor-trace"
+            sub = str(body.get("dir") or "")
+            if sub and (sub != os.path.basename(sub) or sub.startswith(".")):
+                return self._send_error_text(
+                    "dir must be a plain subdirectory name", 400)
+            trace_dir = os.path.join(root, sub) if sub else root
             import time as _time
 
             import jax

@@ -121,6 +121,12 @@ class EngineConfig:
     # 0 disables.  Shared blocks are read-only by construction, so this is
     # refcounting, not copy-on-write.
     prefix_cache_entries: int = 1024
+    # Prefill-priority: while chunk rounds are pending, decode dispatches
+    # only every Nth step — TTFT is completion-order-sensitive and a decode
+    # dispatch between chunk rounds would steal ~half the bandwidth from
+    # every waiting first token.  N bounds decode starvation for lanes
+    # already generating.  1 = strict alternation, large = prefill-first.
+    decode_every_n_chunk_rounds: int = 3
 
 
 class _Slot:
@@ -316,6 +322,7 @@ class InferenceEngine:
         self.steps = 0
         self.prefills = 0
         self.preemptions = 0
+        self._chunks_since_decode = 0
         # TTFT histogram (Prometheus semantics: cumulative le buckets +
         # sum/count), observed once per request at admission reconcile.
         self.ttft_buckets: tuple[float, ...] = (
@@ -433,10 +440,15 @@ class InferenceEngine:
         while rounds < self.ecfg.max_admission_rounds and self._admit_round():
             rounds += 1
             dispatched += 1
-        if self._dispatch_prefill_chunks():
+        chunked = self._dispatch_prefill_chunks()
+        if chunked:
             dispatched += 1
-        if self._dispatch_decode():
-            dispatched += 1
+            self._chunks_since_decode += 1
+        if (not chunked or self._chunks_since_decode
+                >= self.ecfg.decode_every_n_chunk_rounds):
+            if self._dispatch_decode():
+                dispatched += 1
+                self._chunks_since_decode = 0
         if dispatched:
             while len(self._inflight) > self.ecfg.max_inflight:
                 self._reconcile_one()
@@ -486,6 +498,21 @@ class InferenceEngine:
     def _emit(self, req: GenerationRequest, toks: list[int]) -> None:
         if self.token_sink is not None and toks:
             self.token_sink(req.request_id, toks, None)
+
+    def _lane_buffers(self, P: int, bucket: int):
+        """Host-side lane arrays shared by the admission and chunk-round
+        dispatch paths: (tokens, start, lengths, tables, idx, temp, topk,
+        topp).  ``idx`` defaults to max_slots so padding / non-final lanes
+        scatter their sampled token out of range (dropped)."""
+        ec = self.ecfg
+        return (np.zeros((P, bucket), np.int32),
+                np.zeros((P,), np.int32),
+                np.zeros((P,), np.int32),
+                np.zeros((P, ec.max_blocks_per_seq), np.int32),
+                np.full((P,), ec.max_slots, np.int32),
+                np.zeros((P,), np.float32),
+                np.zeros((P,), np.int32),
+                np.ones((P,), np.float32))
 
     def _ensure_free(self, num_tokens: int) -> bool:
         """Make room for ``num_tokens`` of new blocks, evicting LRU prefix
@@ -564,15 +591,8 @@ class InferenceEngine:
         any_shared = any(st > 0 for _, _, _, st in batch)
         bucket = self._bucket(
             max(len(r.prompt_ids) - st for _, r, _, st in batch))
-        tokens = np.zeros((P, bucket), np.int32)
-        start = np.zeros((P,), np.int32)
-        lengths = np.zeros((P,), np.int32)
-        tables = np.zeros((P, ec.max_blocks_per_seq), np.int32)
-        # Padding lanes scatter their (garbage) first token out of range.
-        idx = np.full((P,), ec.max_slots, np.int32)
-        temp = np.zeros((P,), np.float32)
-        topk = np.zeros((P,), np.int32)
-        topp = np.ones((P,), np.float32)
+        (tokens, start, lengths, tables, idx,
+         temp, topk, topp) = self._lane_buffers(P, bucket)
         for j, (slot_idx, req, blocks, st) in enumerate(batch):
             L = len(req.prompt_ids)
             if req.orig_prompt_len < 0:
@@ -647,14 +667,8 @@ class InferenceEngine:
         P = 1 if len(cands) == 1 else ec.max_prefills_per_step
         bucket = self._bucket(min(top, max(
             len(s.req.prompt_ids) - s.prefill_pos for _, s in cands)))
-        tokens = np.zeros((P, bucket), np.int32)
-        start = np.zeros((P,), np.int32)
-        lengths = np.zeros((P,), np.int32)
-        tables = np.zeros((P, ec.max_blocks_per_seq), np.int32)
-        idx = np.full((P,), ec.max_slots, np.int32)   # drop unless final
-        temp = np.zeros((P,), np.float32)
-        topk = np.zeros((P,), np.int32)
-        topp = np.ones((P,), np.float32)
+        (tokens, start, lengths, tables, idx,
+         temp, topk, topp) = self._lane_buffers(P, bucket)
         lanes: list[tuple] = []
         touched: list[_Slot] = []
         final_greedy = True

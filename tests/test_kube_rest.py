@@ -64,7 +64,17 @@ class _Stub(BaseHTTPRequestHandler):
         q = parse_qs(urlparse(self.path).query)
         out = f"ran: {' '.join(q['command'])}\n".encode()
         conn = self.connection
-        conn.sendall(ws_encode_frame(0x2, b"\x01" + out, mask=False))
+        if "frag" in q.get("command", []):
+            # Fragmented stdout: FIN=0 first frame (channel byte + half the
+            # data), opcode-0 continuation with the rest — exercises the
+            # client's message reassembly (a naive reader would misread the
+            # continuation's first byte as a channel id).
+            half = len(out) // 2
+            conn.sendall(ws_encode_frame(0x2, b"\x01" + out[:half],
+                                         mask=False, fin=False))
+            conn.sendall(ws_encode_frame(0x0, out[half:], mask=False))
+        else:
+            conn.sendall(ws_encode_frame(0x2, b"\x01" + out, mask=False))
         conn.sendall(ws_encode_frame(0x2, b"\x02" + b"warn\n", mask=False))
         status = json.dumps({"status": "Failure", "details": {
             "causes": [{"reason": "ExitCode", "message": "3"}]}}).encode()
@@ -220,6 +230,16 @@ def test_exec_websocket(backend):
     out, err, code = backend.exec_in_pod(
         "default", "web", ["ping", "-c", "3", "10.0.0.1"])
     assert out == "ran: ping -c 3 10.0.0.1\n"
+    assert err == "warn\n"
+    assert code == 3
+
+
+def test_exec_websocket_fragmented_frames(backend):
+    """A stdout message split across FIN=0 + continuation frames reassembles
+    to the same bytes (advisor r3: a continuation's first payload byte must
+    not be misread as a channel id)."""
+    out, err, code = backend.exec_in_pod("default", "web", ["frag", "hello"])
+    assert out == "ran: frag hello\n"
     assert err == "warn\n"
     assert code == 3
 

@@ -362,3 +362,55 @@ def test_agent_to_scheduler_end_to_end(sched_world):
         assert req["status"]["assignedNode"] == "node-a"
     finally:
         agent.stop()
+
+
+def test_scheduler_excludes_stale_heartbeat(sched_world):
+    """A dead UAV with a fresh-looking "active" CR must not win placement:
+    last_update older than 3x the advertised heartbeat interval is excluded
+    (the reference parses the heartbeat but never uses it —
+    controller.go:202-203, the SURVEY §2.7 soft spot)."""
+    import datetime
+
+    from k8s_llm_monitor_tpu.monitor.models import utcnow
+
+    fake, client = sched_world
+    old = utcnow() - datetime.timedelta(seconds=60)
+    client.upsert_uav_metric("", UAVReport(
+        node_name="node-a", uav_id="uav-node-a", status="active",
+        timestamp=old, heartbeat_interval_seconds=10,   # 60s >> 3*10s
+        state={"battery": {"remaining_percent": 95.0}},
+    ))
+    _push_uav(client, "node-b", 40.0)                   # fresh, lower battery
+    _make_request(fake, "req-stale")
+    ctrl = SchedulerController(client, SchedulerConfig(tpu_node_bonus=0))
+    ctrl.reconcile()
+    req = _get_request(fake, "req-stale")
+    assert req["status"]["phase"] == "Assigned"
+    assert req["status"]["assignedNode"] == "node-b"    # stale 95% excluded
+
+
+def test_scheduler_stale_default_cap_without_advertised_heartbeat(sched_world):
+    """No advertised heartbeat: the absolute stale_after_seconds cap
+    applies; a within-cap CR is still eligible."""
+    import datetime
+
+    from k8s_llm_monitor_tpu.monitor.models import utcnow
+
+    fake, client = sched_world
+    very_old = utcnow() - datetime.timedelta(seconds=600)
+    client.upsert_uav_metric("", UAVReport(
+        node_name="node-a", uav_id="uav-node-a", status="active",
+        timestamp=very_old,
+        state={"battery": {"remaining_percent": 95.0}},
+    ))
+    recent = utcnow() - datetime.timedelta(seconds=30)
+    client.upsert_uav_metric("", UAVReport(
+        node_name="node-b", uav_id="uav-node-b", status="active",
+        timestamp=recent,
+        state={"battery": {"remaining_percent": 50.0}},
+    ))
+    _make_request(fake, "req-cap")
+    ctrl = SchedulerController(client, SchedulerConfig(tpu_node_bonus=0))
+    ctrl.reconcile()
+    req = _get_request(fake, "req-cap")
+    assert req["status"]["assignedNode"] == "node-b"
