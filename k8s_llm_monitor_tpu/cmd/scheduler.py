@@ -22,6 +22,9 @@ def main(argv: list[str] | None = None) -> int:
         help="cluster backend",
     )
     parser.add_argument("--kubeconfig", default="")
+    parser.add_argument(
+        "--once", action="store_true",
+        help="run a single reconcile pass and exit (scripting/CI)")
     args = parser.parse_args(argv)
 
     logging.basicConfig(
@@ -50,6 +53,10 @@ def main(argv: list[str] | None = None) -> int:
 
     client = Client(backend, namespaces=config.k8s.watch_namespaces)
     ctrl = SchedulerController(client, SchedulerConfig(interval=args.interval))
+    if args.once:
+        n = ctrl.reconcile()
+        log.info("one-shot reconcile processed %d request(s)", n)
+        return 0
     ctrl.start()
     log.info("scheduler controller running (interval %.0fs)", args.interval)
 

@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# Build the UAV agent image, import it into a k3d cluster, roll out the
+# DaemonSet, and print per-node endpoints.
+# (Capability parity: /root/reference/scripts/build-and-deploy-uav-agent.sh
+# — build → k3d import → apply → rollout wait → endpoint listing — rebuilt
+# for this repo's Python agent image, Dockerfile.agent.)
+#
+# Usage: ./scripts/build-and-deploy-uav-agent.sh [k3d-cluster-name]
+set -euo pipefail
+
+CLUSTER="${1:-k8s-llm-monitor}"
+IMAGE="k8s-llm-monitor-agent:dev"
+
+if [ ! -f "Dockerfile.agent" ]; then
+  echo "error: run from the repository root (Dockerfile.agent not found)" >&2
+  exit 1
+fi
+
+echo "==> building $IMAGE"
+docker build -f Dockerfile.agent -t "$IMAGE" .
+
+if command -v k3d >/dev/null 2>&1; then
+  echo "==> importing image into k3d cluster '$CLUSTER'"
+  k3d image import "$IMAGE" -c "$CLUSTER"
+else
+  echo "==> k3d not found; assuming the cluster can pull $IMAGE"
+fi
+
+echo "==> applying CRDs + DaemonSet"
+kubectl apply -f deployments/uav-metrics-crd.yaml
+kubectl apply -f deployments/uav-agent-daemonset.yaml
+
+echo "==> waiting for rollout"
+kubectl rollout status daemonset/uav-agent -n default --timeout=120s
+
+echo
+echo "==> agents"
+kubectl get pods -l app=uav-agent -o wide
+
+echo
+echo "==> per-node endpoints"
+kubectl get pods -l app=uav-agent --no-headers \
+  -o custom-columns=NAME:.metadata.name,NODE:.spec.nodeName,HOST:.status.hostIP \
+  | while read -r name node host; do
+      echo "  $name on $node:"
+      echo "    http://$host:9090/health"
+      echo "    http://$host:9090/api/v1/state"
+    done
+
+cat <<'EOF'
+
+Try:
+  curl http://<host>:9090/api/v1/state
+  curl -X POST http://<host>:9090/api/v1/command/arm
+  curl -X POST http://<host>:9090/api/v1/command/takeoff \
+       -H 'Content-Type: application/json' -d '{"altitude": 50}'
+EOF

@@ -77,6 +77,63 @@ check "mock state shape" \
   bash -c "curl -sf http://127.0.0.1:9090/api/v1/state | grep -q remaining_percent"
 kill "$MOCK_PID" 2>/dev/null; MOCK_PID=""
 
+echo "== 9. data integrity =="
+STATE="$(json /api/v1/metrics/uav/script-node || true)"
+for field in latitude remaining_percent mode system_status; do
+  check "field $field" bash -c "echo '$STATE' | grep -q $field"
+done
+
+echo "== 10. low-battery visibility =="
+LOWBAT='{"node_name":"lowbat-node","uav_id":"uav-low","heartbeat_interval_seconds":10,
+  "state":{"battery":{"remaining_percent":12.0},"health":{"system_status":"WARNING"}}}'
+check "low-battery report" \
+  curl -sf -X POST -H 'Content-Type: application/json' -d "$LOWBAT" \
+  "$BASE/api/v1/uav/report"
+check "low battery visible" \
+  bash -c "curl -sf $BASE/api/v1/metrics/uav/lowbat-node | grep -q '12'"
+
+echo "== 11. response time =="
+T0=$(date +%s%N)
+for _ in 1 2 3 4 5; do curl -sf "$BASE/api/v1/metrics/uav" >/dev/null; done
+T1=$(date +%s%N)
+MS=$(( (T1 - T0) / 5000000 ))
+if [ "$MS" -lt 1000 ]; then
+  echo "  PASS avg response ${MS}ms"; PASS=$((PASS+1))
+else
+  echo "  FAIL avg response ${MS}ms (>= 1000ms)"; FAIL=$((FAIL+1))
+fi
+
+echo "== 12. scheduler assignment chain (kubectl; skipped without a cluster) =="
+# Full pipeline: report (above) -> UAVMetric CR -> SchedulingRequest ->
+# one-shot scheduler reconcile -> status verify.  Mirrors the reference's
+# end-to-end check (scripts/test_uav_collection.sh:1-274) against the NEW
+# scheduler, including the heartbeat-staleness gate.
+if command -v kubectl >/dev/null 2>&1 && kubectl version --request-timeout=3s >/dev/null 2>&1; then
+  kubectl apply -f deployments/uav-metrics-crd.yaml -f deployments/scheduling-crd.yaml >/dev/null
+  cat <<'YAML' | kubectl apply -f - >/dev/null
+apiVersion: scheduler.io/v1
+kind: SchedulingRequest
+metadata:
+  name: smoke-request
+  namespace: default
+spec:
+  workload: {name: smoke-job, namespace: default}
+  minBatteryPercent: 30
+YAML
+  check "one-shot reconcile" \
+    python3 -m k8s_llm_monitor_tpu.cmd.scheduler --once
+  PHASE="$(kubectl get schedulingrequest smoke-request -n default \
+           -o jsonpath='{.status.phase}' 2>/dev/null || true)"
+  if [ "$PHASE" = "Assigned" ] || [ "$PHASE" = "Failed" ]; then
+    echo "  PASS request processed (phase=$PHASE)"; PASS=$((PASS+1))
+  else
+    echo "  FAIL request phase '$PHASE'"; FAIL=$((FAIL+1))
+  fi
+  kubectl delete schedulingrequest smoke-request -n default >/dev/null 2>&1 || true
+else
+  echo "  SKIP (no reachable cluster)"
+fi
+
 echo
 echo "passed $PASS, failed $FAIL"
 [ "$FAIL" -eq 0 ]
