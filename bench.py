@@ -325,6 +325,55 @@ def main() -> None:
         log(f"utilization legs skipped: {exc}")
     del eng  # free the headline KV pool before the long-prompt engine
 
+    # --- W8A8 leg: dynamic per-token activation int8 on top of the int8
+    # weights — prefill runs s8 x s8 on the MXU int8 path (~2-3x the bf16
+    # matmul rate on v5e).  Same weights pytree, separate engine/compile.
+    # Parity contract: tests/test_quantize.py::test_w8a8_forward_parity. --
+    w8a8_p50_ms = w8a8_perchip_p50_ms = None
+    w8a8_wall = 0.0
+    if quant == "int8" and os.environ.get("BENCH_W8A8", "1") == "1":
+        aeng = None
+        try:
+            import dataclasses as _dc
+
+            cfg_aq = _dc.replace(cfg, act_quant=True)
+            aeng = InferenceEngine(cfg_aq, params, ecfg, eos_id=-1)
+            aeng.generate([prompt() for _ in range(2)],
+                          SamplingParams(max_tokens=max_tokens))
+            aeng.generate([prompt()], SamplingParams(max_tokens=4))
+            at0 = time.monotonic()
+            for i in range(n_requests):
+                aeng.submit(GenerationRequest(
+                    request_id=f"aq-{i}", prompt_ids=prompt(),
+                    sampling=SamplingParams(max_tokens=max_tokens)))
+            while aeng.has_work:
+                aeng.step()
+            w8a8_wall = time.monotonic() - at0
+            ares = [aeng.poll(f"aq-{i}") for i in range(n_requests)]
+            assert all(r is not None and r.finish_reason != "error"
+                       for r in ares)
+            w8a8_p50_ms = float(np.percentile(
+                np.array(sorted(r.ttft_s for r in ares)), 50)) * 1e3
+            n_pc = max(1, n_requests // 8)
+            for i in range(n_pc):
+                aeng.submit(GenerationRequest(
+                    request_id=f"aqpc-{i}", prompt_ids=prompt(),
+                    sampling=SamplingParams(max_tokens=max_tokens)))
+            while aeng.has_work:
+                aeng.step()
+            apc = [aeng.poll(f"aqpc-{i}") for i in range(n_pc)]
+            assert all(r is not None and r.finish_reason != "error"
+                       for r in apc)
+            w8a8_perchip_p50_ms = float(np.percentile(
+                np.array(sorted(r.ttft_s for r in apc)), 50)) * 1e3
+            log(f"W8A8: p50 TTFT {w8a8_p50_ms:.1f} ms at {n_requests} "
+                f"concurrent (drained {w8a8_wall:.2f}s); per-chip-equiv "
+                f"{w8a8_perchip_p50_ms:.1f} ms")
+        except Exception as exc:  # noqa: BLE001 — extras never fail the bench
+            log(f"W8A8 leg skipped: {exc}")
+        finally:
+            del aeng  # free its KV pool before the long-prompt engine
+
     # Long-prompt leg: realistic multi-KB diagnosis prompts exercising
     # chunked prefill (prompts > the largest bucket), so the headline number
     # can't hide a slow chunk path.  Separate engine so bucket shapes and the
@@ -476,6 +525,11 @@ def main() -> None:
         extras["long_shared_prefix_p50_ttft_ms"] = round(long_shared_p50_ms, 2)
     if long_perchip_p50_ms is not None:
         extras["long_perchip_equiv_p50_ttft_ms"] = round(long_perchip_p50_ms, 2)
+    if w8a8_p50_ms is not None:
+        extras["w8a8_p50_ttft_ms"] = round(w8a8_p50_ms, 2)
+        extras["w8a8_wall_s"] = round(w8a8_wall, 2)
+    if w8a8_perchip_p50_ms is not None:
+        extras["w8a8_perchip_p50_ttft_ms"] = round(w8a8_perchip_p50_ms, 2)
     log(f"total bench time {time.monotonic() - t0:.0f}s")
     print(json.dumps({
         "metric": "p50_ttft_100c_ms",

@@ -132,13 +132,47 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
 # ---------------------------------------------------------------------------
 
 
-def _linear(p: Params, x: jnp.ndarray) -> jnp.ndarray:
+def _quant_act(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Dynamic per-token symmetric int8: (x_q int8, scale f32 [..., 1])."""
+    amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.maximum(amax / 127.0, 1e-8)
+    x_q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
+                   -127, 127).astype(jnp.int8)
+    return x_q, scale
+
+
+def _linear(p: Params, x: jnp.ndarray, act_quant: bool = False) -> jnp.ndarray:
+    if act_quant and "kernel_q" not in p:
+        # Trace-time check: act_quant requires int8 weights; silently
+        # running bf16 matmuls would hide the misconfiguration behind
+        # benchmarks that show no speedup.
+        import warnings
+
+        warnings.warn(
+            "act_quant=True but weights are not int8-quantized "
+            "(no kernel_q); running the bf16 path — quantize the params "
+            "(utils/quantize.py) to get the s8 x s8 MXU speedup",
+            stacklevel=2)
     if "kernel_q" in p:
-        # Weight-only int8 (utils/quantize.py): per-output-channel scale
-        # commutes with the contraction, so dequant is a [out]-vector
-        # multiply on the result, never a materialized bf16 weight.  The
-        # int8->activation-dtype cast fuses into the MXU operand read.
-        y = (x @ p["kernel_q"].astype(x.dtype)) * p["scale"].astype(x.dtype)
+        if act_quant:
+            # W8A8: s8 x s8 -> s32 on the MXU int8 path (~2-3x the bf16
+            # rate on v5e); both scales factor out of the contraction.
+            x_q, xs = _quant_act(x)
+            y32 = jax.lax.dot_general(
+                x_q, p["kernel_q"],
+                (((x.ndim - 1,), (0,)), ((), ())),
+                preferred_element_type=jnp.int32,
+            )
+            y = (y32.astype(jnp.float32) * xs
+                 * p["scale"]).astype(x.dtype)
+        else:
+            # Weight-only int8 (utils/quantize.py): per-output-channel
+            # scale commutes with the contraction, so dequant is a
+            # [out]-vector multiply on the result, never a materialized
+            # bf16 weight.  The int8->activation-dtype cast fuses into
+            # the MXU operand read.
+            y = ((x @ p["kernel_q"].astype(x.dtype))
+                 * p["scale"].astype(x.dtype))
     else:
         y = x @ p["kernel"]
     if "bias" in p:
@@ -161,18 +195,20 @@ def _qkv(layer: Params, cfg: ModelConfig, x: jnp.ndarray, cos, sin):
     """Project + rope.  x: [B, S, H] -> q [B,S,nH,D], k/v [B,S,nKV,D]."""
     B, S, _ = x.shape
     D = cfg.head_dim_
-    q = _linear(layer["q"], x).reshape(B, S, cfg.num_heads, D)
-    k = _linear(layer["k"], x).reshape(B, S, cfg.num_kv_heads, D)
-    v = _linear(layer["v"], x).reshape(B, S, cfg.num_kv_heads, D)
+    aq = cfg.act_quant
+    q = _linear(layer["q"], x, aq).reshape(B, S, cfg.num_heads, D)
+    k = _linear(layer["k"], x, aq).reshape(B, S, cfg.num_kv_heads, D)
+    v = _linear(layer["v"], x, aq).reshape(B, S, cfg.num_kv_heads, D)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     return q, k, v
 
 
-def _mlp(layer: Params, x: jnp.ndarray) -> jnp.ndarray:
-    gate = _linear(layer["gate"], x)
-    up = _linear(layer["up"], x)
-    return _linear(layer["down"], jax.nn.silu(gate) * up)
+def _mlp(layer: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    aq = cfg.act_quant
+    gate = _linear(layer["gate"], x, aq)
+    up = _linear(layer["up"], x, aq)
+    return _linear(layer["down"], jax.nn.silu(gate) * up, aq)
 
 
 def _unembed(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
@@ -185,6 +221,10 @@ def _unembed(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
         else:
             logits = x @ emb["weight"].T
     else:
+        # The vocab projection stays weight-only even under act_quant:
+        # int8 noise on the pre-logits hidden state flips near-tied argmax
+        # (and the tied-embeddings path is weight-only too) — standard
+        # W8A8 practice excludes the head.
         logits = _linear(params["lm_head"], x)
     return logits.astype(jnp.float32)
 
@@ -220,9 +260,9 @@ def forward_full(
         h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
         q, k, v = _qkv(layer, cfg, h, cos, sin)
         attn = attn_fn(q, k, v, q_positions=positions)
-        x = x + _linear(layer["o"], attn.reshape(B, S, -1))
+        x = x + _linear(layer["o"], attn.reshape(B, S, -1), cfg.act_quant)
         h = rms_norm(x, layer["post_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(layer, h)
+        x = x + _mlp(layer, cfg, h)
     return _unembed(params, cfg, x)
 
 
@@ -307,9 +347,9 @@ def _prefill_impl(
         else:
             kk, vv = k, v
         attn = causal_attention(q, kk, vv, q_positions=positions, kv_len=kv_len)
-        x = x + _linear(layer["o"], attn.reshape(B, S, -1))
+        x = x + _linear(layer["o"], attn.reshape(B, S, -1), cfg.act_quant)
         h = rms_norm(x, layer["post_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(layer, h)
+        x = x + _mlp(layer, cfg, h)
 
     last_idx = jnp.maximum(lengths - 1, 0)
     x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)  # [B,1,H]
@@ -423,9 +463,9 @@ def decode_step(
         new_k.append(pk)
         new_v.append(pv)
         attn = attn_impl(q, pk, pv, block_tables, new_lens)
-        x = x + _linear(layer["o"], attn.reshape(B, 1, -1))
+        x = x + _linear(layer["o"], attn.reshape(B, 1, -1), cfg.act_quant)
         h = rms_norm(x, layer["post_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(layer, h)
+        x = x + _mlp(layer, cfg, h)
 
     logits = _unembed(params, cfg, x)[:, 0, :]
     return logits, KVPages(k=new_k, v=new_v)

@@ -9,7 +9,8 @@
 set -euo pipefail
 
 CLUSTER="${1:-k8s-llm-monitor}"
-IMAGE="k8s-llm-monitor-agent:dev"
+IMAGE="k8s-llm-monitor-tpu-agent:dev"   # must match uav-agent-daemonset.yaml
+NS="monitoring"                          # the DaemonSet's namespace
 
 if [ ! -f "Dockerfile.agent" ]; then
   echo "error: run from the repository root (Dockerfile.agent not found)" >&2
@@ -27,19 +28,20 @@ else
 fi
 
 echo "==> applying CRDs + DaemonSet"
+kubectl create namespace "$NS" --dry-run=client -o yaml | kubectl apply -f -
 kubectl apply -f deployments/uav-metrics-crd.yaml
 kubectl apply -f deployments/uav-agent-daemonset.yaml
 
 echo "==> waiting for rollout"
-kubectl rollout status daemonset/uav-agent -n default --timeout=120s
+kubectl rollout status daemonset/uav-agent -n "$NS" --timeout=120s
 
 echo
 echo "==> agents"
-kubectl get pods -l app=uav-agent -o wide
+kubectl get pods -n "$NS" -l app=uav-agent -o wide
 
 echo
 echo "==> per-node endpoints"
-kubectl get pods -l app=uav-agent --no-headers \
+kubectl get pods -n "$NS" -l app=uav-agent --no-headers \
   -o custom-columns=NAME:.metadata.name,NODE:.spec.nodeName,HOST:.status.hostIP \
   | while read -r name node host; do
       echo "  $name on $node:"
