@@ -22,8 +22,11 @@ Pieces:
 
 from __future__ import annotations
 
+import http.client
 import json
 import logging
+import time
+import urllib.error
 import urllib.request
 import uuid
 from typing import Any
@@ -241,14 +244,34 @@ class LocalEngineBackend(LLMBackend):
 class OpenAICompatBackend(LLMBackend):
     """Remote OpenAI-compatible chat endpoint (the reference's configured
     path, config.go:141-145). Kept for deployments that want it; the
-    north-star path is LocalEngineBackend."""
+    north-star path is LocalEngineBackend.
+
+    Transient failures (HTTP 429/5xx, connection resets, timeouts) are
+    retried with exponential backoff so one 502 doesn't fail a diagnosis
+    outright; non-transient HTTP errors surface the response body in the
+    raised error for debuggability.
+    """
 
     name = "openai"
+    max_retries = 3
+    backoff_s = 0.5
+    _RETRY_STATUS = {429, 500, 502, 503, 504}
 
     def __init__(self, cfg: LLMConfig) -> None:
         self.cfg = cfg
         if not cfg.base_url:
             raise ValueError("llm.base_url required for the openai provider")
+
+    def _post(self, body: bytes):
+        req = urllib.request.Request(
+            self.cfg.base_url.rstrip("/") + "/chat/completions",
+            data=body,
+            headers={
+                "Content-Type": "application/json",
+                "Authorization": f"Bearer {self.cfg.api_key}",
+            },
+        )
+        return urllib.request.urlopen(req, timeout=self.cfg.timeout)
 
     def generate(
         self, prompt: str, max_tokens: int = 512, temperature: float = 0.1
@@ -261,17 +284,43 @@ class OpenAICompatBackend(LLMBackend):
                 "temperature": temperature,
             }
         ).encode()
-        req = urllib.request.Request(
-            self.cfg.base_url.rstrip("/") + "/chat/completions",
-            data=body,
-            headers={
-                "Content-Type": "application/json",
-                "Authorization": f"Bearer {self.cfg.api_key}",
-            },
-        )
-        with urllib.request.urlopen(req, timeout=self.cfg.timeout) as resp:
-            data = json.loads(resp.read())
-        return data["choices"][0]["message"]["content"]
+        last_err: Exception | None = None
+        for attempt in range(self.max_retries + 1):
+            if attempt:
+                time.sleep(self.backoff_s * (2 ** (attempt - 1)))
+            try:
+                with self._post(body) as resp:
+                    raw = resp.read()
+                try:
+                    data = json.loads(raw)
+                except ValueError as exc:
+                    # 200 + non-JSON body (an LB/proxy error page): as
+                    # transient as a 502, and must not surface as a
+                    # caller-side validation error.
+                    raise urllib.error.URLError(
+                        f"non-JSON response from LLM endpoint: "
+                        f"{raw[:200]!r} ({exc})") from exc
+                return data["choices"][0]["message"]["content"]
+            except urllib.error.HTTPError as exc:
+                detail = ""
+                try:
+                    detail = exc.read().decode(errors="replace")[:500]
+                except Exception:  # noqa: BLE001
+                    pass
+                last_err = RuntimeError(
+                    f"LLM endpoint returned {exc.code}: {detail or exc.reason}")
+                if exc.code not in self._RETRY_STATUS:
+                    raise last_err from exc
+                logger.warning("LLM request failed (%s), attempt %d/%d",
+                               exc.code, attempt + 1, self.max_retries + 1)
+            except (urllib.error.URLError, TimeoutError, OSError,
+                    http.client.HTTPException) as exc:
+                # HTTPException covers mid-body failures (IncompleteRead,
+                # RemoteDisconnected) that are not OSError subclasses.
+                last_err = RuntimeError(f"LLM endpoint unreachable: {exc}")
+                logger.warning("LLM request failed (%s), attempt %d/%d",
+                               exc, attempt + 1, self.max_retries + 1)
+        raise last_err  # type: ignore[misc]
 
 
 def build_backend(cfg: LLMConfig) -> LLMBackend:

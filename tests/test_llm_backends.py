@@ -1,0 +1,109 @@
+"""OpenAI-compatible fallback backend: retry on transient failures,
+error-body surfacing on permanent ones (VERDICT r3 weak #5)."""
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from k8s_llm_monitor_tpu.monitor.analysis import OpenAICompatBackend
+from k8s_llm_monitor_tpu.monitor.config import LLMConfig
+
+
+class _StubLLM(BaseHTTPRequestHandler):
+    fail_times = 0          # 502s to serve before succeeding
+    fail_status = 502
+    calls = 0
+
+    def log_message(self, *a):  # noqa: D102
+        pass
+
+    def do_POST(self):  # noqa: N802
+        cls = type(self)
+        cls.calls += 1
+        n = int(self.headers.get("Content-Length", 0))
+        self.rfile.read(n)
+        if cls.calls <= cls.fail_times:
+            body = json.dumps({"error": "upstream exploded"}).encode()
+            self.send_response(cls.fail_status)
+        else:
+            body = json.dumps({"choices": [
+                {"message": {"content": "the pod is OOMKilled"}}]}).encode()
+            self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+@pytest.fixture
+def stub():
+    _StubLLM.calls = 0
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), _StubLLM)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    yield srv
+    srv.shutdown()
+    srv.server_close()
+
+
+def _backend(srv) -> OpenAICompatBackend:
+    cfg = LLMConfig(provider="openai", api_key="k", model="m",
+                    base_url=f"http://127.0.0.1:{srv.server_address[1]}/v1",
+                    timeout=5)
+    b = OpenAICompatBackend(cfg)
+    b.backoff_s = 0.01  # fast tests
+    return b
+
+
+def test_retries_transient_502(stub):
+    _StubLLM.fail_times = 2
+    _StubLLM.fail_status = 502
+    out = _backend(stub).generate("why crashloop?")
+    assert out == "the pod is OOMKilled"
+    assert _StubLLM.calls == 3
+
+
+def test_permanent_error_surfaces_body(stub):
+    _StubLLM.fail_times = 99
+    _StubLLM.fail_status = 401
+    with pytest.raises(RuntimeError) as err:
+        _backend(stub).generate("q")
+    assert "401" in str(err.value) and "upstream exploded" in str(err.value)
+    assert _StubLLM.calls == 1  # 401 is not retried
+
+
+def test_exhausted_retries_raise(stub):
+    _StubLLM.fail_times = 99
+    _StubLLM.fail_status = 503
+    b = _backend(stub)
+    with pytest.raises(RuntimeError) as err:
+        b.generate("q")
+    assert "503" in str(err.value)
+    assert _StubLLM.calls == b.max_retries + 1
+
+
+def test_non_json_200_is_retried(stub):
+    """200 + HTML error page (LB/proxy) is as transient as a 502 and must
+    not escape as a raw JSONDecodeError."""
+    class _HTML(_StubLLM):
+        def do_POST(self):  # noqa: N802
+            cls = _StubLLM
+            cls.calls += 1
+            n = int(self.headers.get("Content-Length", 0))
+            self.rfile.read(n)
+            if cls.calls <= cls.fail_times:
+                body = b"<html>503 Service Unavailable</html>"
+                self.send_response(200)
+            else:
+                body = json.dumps({"choices": [
+                    {"message": {"content": "ok"}}]}).encode()
+                self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+    stub.RequestHandlerClass = _HTML
+    _StubLLM.fail_times = 1
+    out = _backend(stub).generate("q")
+    assert out == "ok" and _StubLLM.calls == 2
