@@ -57,26 +57,25 @@ def _decode_kernel(
     tables_ref,            # [B, NB] int32 block ids
     lens_ref,              # [B] int32 valid kv length per sequence
     # inputs
-    q_ref,                 # [1, H, KVH*D] block-diagonal queries (VMEM)
+    q_ref,                 # [TB, H, KVH*D] block-diagonal queries (VMEM)
     k_hbm,                 # [num_blocks, bs, KVH*D] (ANY/HBM, whole array)
     v_hbm,                 # same
     # out
-    o_ref,                 # [1, H, KVH*D]
+    o_ref,                 # [TB, H, KVH*D]
 ):
-    b = pl.program_id(0)
+    TB = q_ref.shape[0]                                    # seqs per program
+    b0 = pl.program_id(0) * TB
     bs = k_hbm.shape[1]
     H = q_ref.shape[1]
     F = q_ref.shape[2]                                     # KVH * D
     NB = tables_ref.shape[1]
     W = min(_WINDOW, NB)
-    length = lens_ref[b]
-    n_blocks = (length + bs - 1) // bs                     # >= 1 (length >= 1)
-    n_windows = (n_blocks + W - 1) // W
 
     def scoped(k_buf, v_buf, sem):
-        # k_buf/v_buf: [2, W*bs, F] double-buffered page slabs;
-        # sem: [2, W, 2] one DMA semaphore pair per page slot.
-        def start_window(slot, w):
+        # k_buf/v_buf: [2, W*bs, F] double-buffered page slabs, reused
+        # across the program's TB sequences; sem: [2, W, 2] one DMA
+        # semaphore pair per page slot.
+        def start_window(slot, b, w):
             # Issue all W page copies of window ``w`` back-to-back; table
             # indices past the sequence's pages clamp to a duplicate id
             # (rows are masked by position later), so the burst shape is
@@ -91,7 +90,7 @@ def _decode_kernel(
                     v_hbm.at[blk], v_buf.at[slot, pl.ds(i * bs, bs)],
                     sem.at[slot, i, 1]).start()
 
-        def wait_window(slot, w):
+        def wait_window(slot, b, w):
             for i in range(W):
                 j = jnp.minimum(w * W + i, NB - 1)
                 blk = tables_ref[b, j]
@@ -102,52 +101,61 @@ def _decode_kernel(
                     v_hbm.at[blk], v_buf.at[slot, pl.ds(i * bs, bs)],
                     sem.at[slot, i, 1]).wait()
 
-        start_window(0, 0)
-        q = q_ref[0].astype(jnp.float32)                   # [H, F] block-diag
+        # Static unroll over the tile's sequences: one program amortizes
+        # grid startup over TB sequences' attention.  Each sequence still
+        # pays its own window-0 DMA stall (the shared double buffers make
+        # cross-sequence prefetch non-trivial; measured immaterial on v5e).
+        for t in range(TB):
+            b = b0 + t
+            length = lens_ref[b]
+            n_blocks = (length + bs - 1) // bs             # >= 1
+            n_windows = (n_blocks + W - 1) // W
+            start_window(0, b, 0)
+            q = q_ref[t].astype(jnp.float32)               # [H, F] block-diag
 
-        def body(w, carry):
-            m, l, acc = carry                  # [H, 1], [H, 1], [H, F] (f32)
-            slot = jax.lax.rem(w, 2)
+            def body(w, carry, b=b, length=length, n_windows=n_windows):
+                m, l, acc = carry              # [H, 1], [H, 1], [H, F] (f32)
+                slot = jax.lax.rem(w, 2)
 
-            @pl.when(w + 1 < n_windows)
-            def _prefetch():
-                start_window(1 - slot, w + 1)
+                @pl.when(w + 1 < n_windows)
+                def _prefetch():
+                    start_window(1 - slot, b, w + 1)
 
-            wait_window(slot, w)
-            pos = (w * (W * bs)
-                   + jax.lax.broadcasted_iota(jnp.int32, (1, W * bs), 1))
-            valid = pos < length                            # [1, W*bs]
-            kblk = k_buf[slot].astype(jnp.float32)          # [W*bs, F]
-            vblk = v_buf[slot].astype(jnp.float32)
+                wait_window(slot, b, w)
+                pos = (w * (W * bs)
+                       + jax.lax.broadcasted_iota(jnp.int32, (1, W * bs), 1))
+                valid = pos < length                        # [1, W*bs]
+                kblk = k_buf[slot].astype(jnp.float32)      # [W*bs, F]
+                vblk = v_buf[slot].astype(jnp.float32)
 
-            # Block-diagonal q makes this one dot per window: head h only
-            # overlaps its own kv group's D-slice, so cross-head products
-            # are zero.
-            s = jax.lax.dot_general(
-                q, kblk, (((1,), (1,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )                                               # [H, W*bs]
-            s = jnp.where(valid, s, NEG_INF)
+                # Block-diagonal q makes this one dot per window: head h
+                # only overlaps its own kv group's D-slice, so cross-head
+                # products are zero.
+                s = jax.lax.dot_general(
+                    q, kblk, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )                                           # [H, W*bs]
+                s = jnp.where(valid, s, NEG_INF)
 
-            m_cur = jnp.max(s, axis=-1, keepdims=True)
-            m_new = jnp.maximum(m, m_cur)
-            alpha = jnp.exp(m - m_new)
-            p = jnp.exp(s - m_new)                          # [H, W*bs]
-            l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
-            pv = jax.lax.dot_general(
-                p, vblk, (((1,), (0,)), ((), ())),
-                preferred_element_type=jnp.float32,
-            )                                               # [H, F]
-            return m_new, l_new, alpha * acc + pv
+                m_cur = jnp.max(s, axis=-1, keepdims=True)
+                m_new = jnp.maximum(m, m_cur)
+                alpha = jnp.exp(m - m_new)
+                p = jnp.exp(s - m_new)                      # [H, W*bs]
+                l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+                pv = jax.lax.dot_general(
+                    p, vblk, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32,
+                )                                           # [H, F]
+                return m_new, l_new, alpha * acc + pv
 
-        m0 = jnp.full((H, 1), NEG_INF, jnp.float32)
-        l0 = jnp.zeros((H, 1), jnp.float32)
-        acc0 = jnp.zeros((H, F), jnp.float32)
-        _, l, acc = jax.lax.fori_loop(0, n_windows, body, (m0, l0, acc0))
-        # acc rows carry the head's output in its kv-group slice (plus
-        # group-mates' contributions in other slices, sliced away by the
-        # caller).
-        o_ref[0] = (acc / l).astype(o_ref.dtype)
+            m0 = jnp.full((H, 1), NEG_INF, jnp.float32)
+            l0 = jnp.zeros((H, 1), jnp.float32)
+            acc0 = jnp.zeros((H, F), jnp.float32)
+            _, l, acc = jax.lax.fori_loop(0, n_windows, body, (m0, l0, acc0))
+            # acc rows carry the head's output in its kv-group slice (plus
+            # group-mates' contributions in other slices, sliced away by
+            # the caller).
+            o_ref[t] = (acc / l).astype(o_ref.dtype)
 
     pl.run_scoped(
         scoped,
@@ -197,15 +205,23 @@ def paged_decode_attention_pallas(
     q_bd = (q[:, 0, :, None, :] * (D ** -0.5)
             * onehot[None, :, :, None]).reshape(B, H, F)
 
+    # Batch-tile: TB sequences per program amortize per-program grid
+    # startup — at B=128 this is 16 programs instead of 128, 8 per
+    # megacore half.  (Measured neutral vs grid=(B,) on v5e at B=128; the
+    # decode-attention cost there is dependency-serialization against the
+    # surrounding matmuls, not program count.)  Keep at least 2 programs
+    # so both megacore halves stay busy at small B.
+    TB = next(tb for tb in (8, 4, 2, 1)
+              if B % tb == 0 and (B // tb >= 2 or B == 1))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
-        grid=(B,),
+        grid=(B // TB,),
         in_specs=[
-            pl.BlockSpec((1, H, F), lambda b, tbl, lens: (b, 0, 0)),
+            pl.BlockSpec((TB, H, F), lambda p, tbl, lens: (p, 0, 0)),
             pl.BlockSpec(memory_space=pl.ANY),   # K pages stay in HBM
             pl.BlockSpec(memory_space=pl.ANY),   # V pages stay in HBM
         ],
-        out_specs=pl.BlockSpec((1, H, F), lambda b, tbl, lens: (b, 0, 0)),
+        out_specs=pl.BlockSpec((TB, H, F), lambda p, tbl, lens: (p, 0, 0)),
     )
 
     out_full = pl.pallas_call(
@@ -213,8 +229,8 @@ def paged_decode_attention_pallas(
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, H, F), q.dtype),
         compiler_params=pltpu.CompilerParams(
-            # Programs touch disjoint q/o rows and only read pages: the
-            # batch grid is safely parallel (megacore splits it).
+            # Programs touch disjoint q/o tiles and only read pages: the
+            # tile grid is safely parallel (megacore splits it).
             dimension_semantics=("parallel",),
         ),
         interpret=interpret,
