@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# One-command live smoke: boot the monitor server against the in-memory
+# demo cluster (template LLM — no model compile) and run the end-to-end
+# API check suite against it.
+# (Capability parity with the reference's root test_server.sh /
+# test_web_interface.sh / test_with_mock_k8s.sh trio, consolidated.)
+#
+# Usage: ./scripts/smoke.sh [port]          (default 18230)
+set -euo pipefail
+
+PORT="${1:-18230}"
+cd "$(dirname "$0")/.."
+
+python3 -m k8s_llm_monitor_tpu.cmd.server \
+  --cluster fake --llm template --port "$PORT" >/tmp/monitor-smoke.log 2>&1 &
+SRV=$!
+trap 'kill $SRV 2>/dev/null || true' EXIT
+
+echo "==> waiting for server on :$PORT"
+for _ in $(seq 1 30); do
+  curl -sf "http://127.0.0.1:$PORT/health" >/dev/null 2>&1 && break
+  sleep 1
+done
+curl -sf "http://127.0.0.1:$PORT/health" >/dev/null || {
+  echo "server failed to boot; log tail:"; tail -20 /tmp/monitor-smoke.log
+  exit 1
+}
+
+echo "==> dashboard reachable"
+curl -sf "http://127.0.0.1:$PORT/" | grep -q "k8s-llm-monitor"
+
+echo "==> API pipeline"
+./scripts/test_uav_collection.sh "http://127.0.0.1:$PORT"
