@@ -5,7 +5,7 @@ PY ?= python
 TEST_ENV = env PYTHONPATH= JAX_PLATFORMS=cpu
 
 .PHONY: run run-agent run-scheduler demo test test-fast bench dryrun \
-        docker docker-agent docker-scheduler lint clean
+        smoke deploy-agent docker docker-agent docker-scheduler lint clean
 
 run:
 	$(PY) -m k8s_llm_monitor_tpu.cmd.server --cluster fake --port 8081
@@ -30,6 +30,12 @@ test-fast:          # monitor plane only (no jax compiles)
 
 bench:
 	$(PY) bench.py
+
+smoke:              # boot server + 20-check live API suite
+	$(TEST_ENV) bash scripts/smoke.sh
+
+deploy-agent:       # build agent image, k3d import, roll out DaemonSet
+	bash scripts/build-and-deploy-uav-agent.sh
 
 dryrun:
 	env PYTHONPATH= $(PY) __graft_entry__.py 8

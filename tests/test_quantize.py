@@ -233,3 +233,30 @@ def test_w8a8_engine_self_consistent():
 
     for p, r in zip(prompts, results):
         assert r.token_ids == naive(p, 5)
+
+
+def test_70b_int8_specs_divide_on_tp8_and_tp16():
+    """BASELINE config #5 with int8 weights: every sharded axis of the
+    quantized 70B/72B pytrees divides TP-8 and TP-16 (checked via
+    eval_shape — no 70B weights materialized)."""
+    from k8s_llm_monitor_tpu.models.config import PRESETS
+    from k8s_llm_monitor_tpu.parallel.sharding import param_partition_specs
+
+    for name in ("llama3-70b", "qwen2-72b"):
+        cfg = PRESETS[name]
+        shapes = jax.eval_shape(
+            lambda rng, c=cfg: qz.init_params_quantized(rng, c),
+            jax.random.PRNGKey(0))
+        specs = param_partition_specs(shapes)
+        for tp in (8, 16):
+            def check(path, leaf, spec):
+                for dim, axis in enumerate(spec):
+                    if axis == "model":
+                        assert leaf.shape[dim] % tp == 0, (
+                            f"{name} tp={tp}: {path} {leaf.shape}")
+            jax.tree_util.tree_map_with_path(
+                lambda p, l, s: check(p, l, s), shapes, specs)
+        # int8 70B-class weights must fit a v5p-16's per-chip HBM budget.
+        total = sum(l.size * l.dtype.itemsize
+                    for l in jax.tree_util.tree_leaves(shapes))
+        assert total < 80 * 2**30, f"{name}: {total/2**30:.1f} GiB int8"
