@@ -159,3 +159,62 @@ def test_stress_waves_of_submissions(params):
     if eng.prefix_cache is not None:
         eng.prefix_cache.clear()   # cache-held refs are not leaks
     assert eng.allocator.free_blocks == 48 - 1
+
+
+def test_stress_long_prompts_shared_prefixes_and_cancels(params):
+    """The round-4 machinery under randomized load: streaming chunked long
+    prompts, prefix-cache hits at every length, cache eviction under a
+    tiny pool, preemption, and cancels — must drain without deadlock,
+    error results, or leaked blocks."""
+    eng = InferenceEngine(
+        CFG, params,
+        EngineConfig(max_slots=4, num_blocks=56, block_size=4,
+                     max_blocks_per_seq=32, prefill_buckets=(8, 16),
+                     max_prefills_per_step=4, max_admission_rounds=2,
+                     decode_steps_per_iter=4, max_inflight=2,
+                     decode_every_n_chunk_rounds=2),
+        eos_id=7,
+    )
+    rng = np.random.default_rng(11)
+    prefixes = [list(rng.integers(8, 300, size=n)) for n in (12, 24, 40)]
+    ids, cancelled = [], set()
+    steps = 0
+    for wave in range(6):
+        for j in range(5):
+            rid = f"w{wave}-{j}"
+            ids.append(rid)
+            kind = rng.integers(0, 4)
+            if kind == 0:                       # short, unique
+                prompt = list(rng.integers(8, 300, size=int(rng.integers(3, 14))))
+            elif kind == 1:                     # shared prefix + tail
+                prompt = list(prefixes[int(rng.integers(0, len(prefixes)))]) \
+                    + list(rng.integers(8, 300, size=int(rng.integers(1, 6))))
+            elif kind == 2:                     # long (chunk-streamed)
+                prompt = list(rng.integers(8, 300, size=int(rng.integers(20, 60))))
+            else:                               # long + shared prefix
+                prompt = prefixes[2] + \
+                    list(rng.integers(8, 300, size=int(rng.integers(20, 40))))
+            eng.submit(GenerationRequest(
+                rid, prompt,
+                SamplingParams(max_tokens=int(rng.integers(1, 10)))))
+        for _ in range(int(rng.integers(1, 5))):
+            if eng.has_work:
+                eng.step()
+                steps += 1
+        if wave % 2 == 1:                       # cancel something random
+            victim = ids[int(rng.integers(0, len(ids)))]
+            if eng.cancel(victim):
+                cancelled.add(victim)
+    while eng.has_work:
+        eng.step()
+        steps += 1
+        assert steps < 5_000
+    for rid in ids:
+        r = eng.poll(rid)
+        assert r is not None, f"{rid} dropped"
+        if rid in cancelled and r.finish_reason == "error":
+            continue
+        assert r.finish_reason in ("eos", "length"), (rid, r)
+    assert eng.prefix_cache.hits > 0           # the shared tails actually hit
+    eng.prefix_cache.clear()
+    assert eng.allocator.free_blocks == 56 - 1  # no leaked blocks
