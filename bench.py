@@ -473,7 +473,7 @@ def main() -> None:
 
         enc_name = os.environ.get(
             "BENCH_ENCODER",
-            "bge-large" if dev.platform == "tpu" else "tiny-encoder")
+            "bge-large-bf16" if dev.platform == "tpu" else "tiny-encoder")
         det = EmbeddingAnomalyDetector(ENCODER_PRESETS[enc_name])
         docs = [f"Warning: BackOff restarting failed container web-{i} "
                 f"in pod default/web-{i}; exit code 137 OOMKilled" * 3

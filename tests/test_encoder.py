@@ -124,3 +124,25 @@ def test_anomaly_detector_small_batches_and_empty():
     assert det.score([]) == []
     scores = det.score(["same text"] * 5)
     assert max(scores) < 1e-3  # identical texts sit at the centroid
+
+
+def test_bf16_encoder_tracks_f32():
+    """The bf16 serving variant's embeddings stay close to f32 (pooling
+    and normalization are f32 either way) — the contract behind the
+    bge-large-bf16 bench preset."""
+    import dataclasses as _dc
+
+    import numpy as np
+
+    from k8s_llm_monitor_tpu.analysis.anomaly import EmbeddingAnomalyDetector
+    from k8s_llm_monitor_tpu.models.config import TINY_ENCODER
+
+    docs = [f"container web-{i} OOMKilled exit 137" for i in range(8)]
+    docs[5] = "scheduler assigned uav survey job to node-b"
+    det32 = EmbeddingAnomalyDetector(TINY_ENCODER)
+    det16 = EmbeddingAnomalyDetector(
+        _dc.replace(TINY_ENCODER, name="tiny-bf16", dtype="bfloat16"))
+    e32 = np.asarray(det32.embed(docs))
+    e16 = np.asarray(det16.embed(docs))
+    cos = (e32 * e16).sum(-1)  # both L2-normalized
+    assert cos.min() > 0.99, cos
