@@ -37,6 +37,11 @@ class ModelConfig:
     qkv_bias: bool = False          # True for Qwen2
     tie_embeddings: bool = False
     dtype: str = "bfloat16"
+    # KV cache dtype ('' = same as dtype).  "float8_e4m3fn" halves the KV
+    # pool and the decode-attention DMA traffic; Q stays bf16 and the
+    # kernel/softmax run f32, so logits track the bf16-KV model closely
+    # (tested).  Opt-in: accuracy headroom is workload-dependent.
+    kv_dtype: str = ""
     # W8A8: dynamically quantize activations (per-token symmetric int8) at
     # every linear so the matmul runs s8 x s8 on the MXU's int8 path —
     # ~2-3x the bf16 matmul rate on v5e, i.e. ~2x faster prefill for

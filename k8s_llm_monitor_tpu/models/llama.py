@@ -70,7 +70,7 @@ class KVPages(NamedTuple):
 
 
 def init_kv_pages(cfg: ModelConfig, num_blocks: int, block_size: int) -> KVPages:
-    dtype = jnp.dtype(cfg.dtype)
+    dtype = jnp.dtype(cfg.kv_dtype or cfg.dtype)
     shape = (num_blocks, block_size, cfg.num_kv_heads * cfg.head_dim_)
     return KVPages(
         k=[jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)],
@@ -295,7 +295,10 @@ def _scatter_pages(
     flat_blocks = block_ids.reshape(-1)
     flat_offs = offs.reshape(-1)
     flat_vals = vals.reshape(B * S, -1)              # fuse [KVH, D] -> lanes
-    return pages.at[flat_blocks, flat_offs].set(flat_vals)
+    # Explicit cast: fp8 KV pages (ModelConfig.kv_dtype) have no implicit
+    # promotion path from the bf16 projections.
+    return pages.at[flat_blocks, flat_offs].set(
+        flat_vals.astype(pages.dtype))
 
 
 # ---------------------------------------------------------------------------
