@@ -329,7 +329,7 @@ def main() -> None:
     # weights — prefill runs s8 x s8 on the MXU int8 path (~2-3x the bf16
     # matmul rate on v5e).  Same weights pytree, separate engine/compile.
     # Parity contract: tests/test_quantize.py::test_w8a8_forward_parity. --
-    w8a8_p50_ms = w8a8_perchip_p50_ms = None
+    w8a8_p50_ms = w8a8_perchip_p50_ms = w8a8_shared_p50_ms = None
     w8a8_wall = 0.0
     if quant == "int8" and os.environ.get("BENCH_W8A8", "1") == "1":
         aeng = None
@@ -369,6 +369,30 @@ def main() -> None:
             log(f"W8A8: p50 TTFT {w8a8_p50_ms:.1f} ms at {n_requests} "
                 f"concurrent (drained {w8a8_wall:.2f}s); per-chip-equiv "
                 f"{w8a8_perchip_p50_ms:.1f} ms")
+
+            # W8A8 + shared prefix: the realistic diagnosis shape at the
+            # full 100-concurrent load on ONE chip.
+            pre2 = prompt()[:shared_len]
+
+            def w8a8_shared() -> list[int]:
+                return pre2 + list(rng.integers(
+                    4, cfg.vocab_size - 4, size=prompt_len - shared_len))
+            aeng.generate([w8a8_shared()], SamplingParams(max_tokens=4))
+            aeng.generate([w8a8_shared() for _ in range(2)],
+                          SamplingParams(max_tokens=4))
+            for i in range(n_requests):
+                aeng.submit(GenerationRequest(
+                    request_id=f"aqsh-{i}", prompt_ids=w8a8_shared(),
+                    sampling=SamplingParams(max_tokens=max_tokens)))
+            while aeng.has_work:
+                aeng.step()
+            ash = [aeng.poll(f"aqsh-{i}") for i in range(n_requests)]
+            assert all(r is not None and r.finish_reason != "error"
+                       for r in ash)
+            w8a8_shared_p50_ms = float(np.percentile(
+                np.array(sorted(r.ttft_s for r in ash)), 50)) * 1e3
+            log(f"W8A8 shared-prefix: p50 TTFT {w8a8_shared_p50_ms:.1f} ms "
+                f"at {n_requests} concurrent")
         except Exception as exc:  # noqa: BLE001 — extras never fail the bench
             log(f"W8A8 leg skipped: {exc}")
         finally:
@@ -394,7 +418,14 @@ def main() -> None:
             max_admission_rounds=4,
             decode_steps_per_iter=8,
         )
-        leng = InferenceEngine(cfg, params, lcfg, eos_id=-1)
+        # Long-prompt chunks are pure prefill compute — run them W8A8
+        # (same parity contract as the headline W8A8 leg) when the weights
+        # are int8; extras record the mode.
+        import dataclasses as _dc
+
+        long_cfg = (_dc.replace(cfg, act_quant=True)
+                    if quant == "int8" else cfg)
+        leng = InferenceEngine(long_cfg, params, lcfg, eos_id=-1)
 
         def long_prompt() -> list[int]:
             return list(rng.integers(4, cfg.vocab_size - 4, size=long_len))
@@ -521,6 +552,7 @@ def main() -> None:
         extras["decode_bw_util"] = round(decode_bw_util, 3)
     if long_p50_ms is not None:  # 0.0 would read as a perfect score
         extras["long_prompt_p50_ttft_ms"] = round(long_p50_ms, 2)
+        extras["long_quant"] = "w8a8" if quant == "int8" else quant
     if long_shared_p50_ms is not None:
         extras["long_shared_prefix_p50_ttft_ms"] = round(long_shared_p50_ms, 2)
     if long_perchip_p50_ms is not None:
@@ -530,6 +562,8 @@ def main() -> None:
         extras["w8a8_wall_s"] = round(w8a8_wall, 2)
     if w8a8_perchip_p50_ms is not None:
         extras["w8a8_perchip_p50_ttft_ms"] = round(w8a8_perchip_p50_ms, 2)
+    if w8a8_shared_p50_ms is not None:
+        extras["w8a8_shared_prefix_p50_ttft_ms"] = round(w8a8_shared_p50_ms, 2)
     log(f"total bench time {time.monotonic() - t0:.0f}s")
     print(json.dumps({
         "metric": "p50_ttft_100c_ms",
