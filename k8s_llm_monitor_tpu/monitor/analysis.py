@@ -141,7 +141,8 @@ class LocalEngineBackend(LLMBackend):
         from k8s_llm_monitor_tpu.utils.tokenizer import load_tokenizer
 
         dev_weights = not tpu_cfg.checkpoint
-        quantize = getattr(tpu_cfg, "quantize", "") == "int8"
+        qmode = getattr(tpu_cfg, "quantize", "")
+        quantize = qmode in ("int8", "w8a8")
         if tpu_cfg.checkpoint:
             from k8s_llm_monitor_tpu.utils.checkpoint import load_hf_checkpoint
 
@@ -161,6 +162,13 @@ class LocalEngineBackend(LLMBackend):
             else:
                 params = llama.init_params(jax.random.PRNGKey(0), cfg)
             tokenizer = load_tokenizer(None)
+
+        if qmode == "w8a8":
+            # s8 x s8 prefill on the MXU int8 path (~2.6x TTFT headroom);
+            # see utils/quantize.py and the bench's W8A8 legs.
+            import dataclasses as _dc
+
+            cfg = _dc.replace(cfg, act_quant=True)
 
         mesh = None
         if tpu_cfg.mesh_shape:

@@ -356,3 +356,43 @@ def test_evidence_collector_bounds_events():
     assert len(ev["recent_warning_events"]) == 10
     prompt = coll.format_prompt(ev)
     assert "Recent warning events" in prompt
+
+
+def test_concurrent_queries_share_evidence_prefix():
+    """The production query path builds prompts as preamble + evidence +
+    question, so concurrent diagnosis queries against the same snapshot
+    reuse the evidence prefix through the engine's KV prefix cache —
+    the mechanism behind the shared-prefix bench leg."""
+    import jax
+
+    from k8s_llm_monitor_tpu.models import llama
+    from k8s_llm_monitor_tpu.models.config import ModelConfig
+    from k8s_llm_monitor_tpu.serving.engine import EngineConfig, InferenceEngine
+    from k8s_llm_monitor_tpu.utils.tokenizer import ByteTokenizer
+
+    cfg = ModelConfig(name="tiny", vocab_size=300, hidden_size=32,
+                      intermediate_size=64, num_layers=2, num_heads=4,
+                      num_kv_heads=2, dtype="float32", rope_theta=1e4)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    tok = ByteTokenizer()
+    engine = InferenceEngine(
+        cfg, params,
+        EngineConfig(max_slots=2, num_blocks=768, block_size=16,
+                     max_blocks_per_seq=192,
+                     prefill_buckets=(128, 512, 2048)),
+        tokenizer=tok,
+    )
+    backend = LocalEngineBackend(engine, tok)
+    fake = seed_demo_cluster(FakeCluster())
+    client = Client(fake, namespaces=["default"])
+    manager = Manager(client, MetricsConfig(namespaces=["default"]))
+    manager.collect()
+    analysis = AnalysisEngine(backend, client=client, manager=manager)
+
+    for q in ("why is web-frontend slow?",
+              "is the uav fleet healthy today?",
+              "which node is under memory pressure?"):
+        resp = analysis.query(q)
+        assert resp.status == "success"
+    pc = engine.prefix_cache
+    assert pc is not None and pc.hits >= 2, (pc.hits, pc.misses)
