@@ -38,6 +38,9 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--data", default="", help="flat int32 token .npy")
     parser.add_argument("--seed", type=int, default=0,
                         help="data-stream seed (offset by resumed step)")
+    parser.add_argument("--multihost", action="store_true",
+                        help="join a multi-host JAX runtime (DCN across "
+                             "hosts; see parallel.mesh.init_multihost)")
     parser.add_argument("--ckpt-dir", default="")
     parser.add_argument("--ckpt-every", type=int, default=50)
     parser.add_argument("--resume", default="", help="checkpoint to restore")
@@ -67,6 +70,13 @@ def main(argv: list[str] | None = None) -> int:
     )
 
     cfg = PRESETS[args.model]
+    if args.multihost:
+        from k8s_llm_monitor_tpu.parallel.mesh import init_multihost
+
+        pid = init_multihost()
+        log.info("multihost: process %d/%d, %d local of %d global devices",
+                 pid, jax.process_count(), jax.local_device_count(),
+                 jax.device_count())
     n_dev = len(jax.devices())
     if args.mesh:
         d, s, m = (int(x) for x in args.mesh.split(","))

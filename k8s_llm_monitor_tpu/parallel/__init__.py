@@ -9,7 +9,11 @@ K8s watch streams + HTTP); this subsystem is a new obligation from the
 north-star serving targets (v5e-8 TP, v5p-16 TP for 70B-class).
 """
 
-from k8s_llm_monitor_tpu.parallel.mesh import MeshConfig, create_mesh
+from k8s_llm_monitor_tpu.parallel.mesh import (
+    MeshConfig,
+    create_mesh,
+    init_multihost,
+)
 from k8s_llm_monitor_tpu.parallel.sharding import (
     param_partition_specs,
     kv_pages_partition_specs,
@@ -19,6 +23,7 @@ from k8s_llm_monitor_tpu.parallel.sharding import (
 __all__ = [
     "MeshConfig",
     "create_mesh",
+    "init_multihost",
     "param_partition_specs",
     "kv_pages_partition_specs",
     "shard_params",

@@ -21,6 +21,46 @@ from jax.sharding import Mesh
 AXES = ("data", "seq", "model")
 
 
+def init_multihost(coordinator: str | None = None,
+                   num_processes: int | None = None,
+                   process_id: int | None = None) -> int:
+    """Join a multi-host JAX runtime (DCN between hosts, ICI within).
+
+    On GKE/TPU-VM slices the environment usually carries everything and a
+    bare ``jax.distributed.initialize()`` suffices; explicit arguments
+    cover manual launches (`JAX_COORDINATOR` / `NUM_PROCESSES` /
+    `PROCESS_ID` env vars work too).  Idempotent: repeated calls are
+    no-ops.  Returns this host's process index.
+
+    Axis placement rule for multi-host meshes (see SURVEY §5.8 / the
+    scaling-book recipe): keep ``model`` (and ``seq`` for ring attention)
+    within a host's ICI domain and spread ``data`` across hosts, so the
+    per-step psum over ``data`` is the only collective riding DCN.
+    ``create_mesh`` preserves that ordering because jax.devices()
+    enumerates local devices contiguously per process.
+    """
+    import os
+
+    if jax.process_count() > 1:
+        return jax.process_index()  # already initialized by the runtime
+    coordinator = coordinator or os.environ.get("JAX_COORDINATOR")
+    num_processes = num_processes or int(os.environ.get("NUM_PROCESSES", 0))
+    process_id = (process_id if process_id is not None
+                  else int(os.environ.get("PROCESS_ID", -1)))
+    if coordinator and num_processes > 1 and process_id >= 0:
+        jax.distributed.initialize(
+            coordinator_address=coordinator,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+    else:
+        try:
+            jax.distributed.initialize()  # env/metadata-driven (TPU VM)
+        except Exception:  # noqa: BLE001 — single-host runs stay single
+            pass
+    return jax.process_index()
+
+
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
     data: int = 1

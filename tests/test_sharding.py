@@ -153,3 +153,11 @@ def test_tp_engine_selects_pallas_kernel_path(cpu_mesh_devices):
         cfg3, llama.init_params(jax.random.PRNGKey(1), cfg3),
         ecfg, eos_id=-1, mesh=mesh)
     assert eng2._attn_impl is paged_decode_attention
+
+
+def test_init_multihost_single_host_noop(cpu_mesh_devices):
+    """init_multihost on a single host is a safe no-op returning index 0."""
+    from k8s_llm_monitor_tpu.parallel.mesh import init_multihost
+
+    assert init_multihost() == 0
+    assert init_multihost() == 0  # idempotent
