@@ -317,6 +317,7 @@ def _prefill_impl(
     pages: KVPages,
     block_tables: jnp.ndarray,
     attend_to_pages: bool,
+    return_all_logits: bool = False,
 ) -> tuple[jnp.ndarray, KVPages]:
     """Shared prefill layer loop.
 
@@ -325,6 +326,10 @@ def _prefill_impl(
     paged cache after scattering (continuation chunks attending to a cached
     prefix).  Everything else — embed, qkv+rope, scatter, residual/MLP,
     last-valid-token unembed — is identical and lives here exactly once.
+
+    ``return_all_logits`` switches the unembed from the last valid token
+    ([B, V]) to every position ([B, S, V]) — the speculative-decode verify
+    pass needs per-position logits to score its draft tokens.
     """
     B, S = tokens.shape
     cos, sin = rope_angles(positions, cfg.head_dim_, cfg.rope_theta,
@@ -354,6 +359,8 @@ def _prefill_impl(
         h = rms_norm(x, layer["post_norm"], cfg.rms_norm_eps)
         x = x + _mlp(layer, cfg, h)
 
+    if return_all_logits:
+        return _unembed(params, cfg, x), KVPages(k=new_k, v=new_v)
     last_idx = jnp.maximum(lengths - 1, 0)
     x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)  # [B,1,H]
     logits = _unembed(params, cfg, x_last)[:, 0, :]
@@ -420,6 +427,41 @@ def prefill_chunk(
     return _prefill_impl(params, cfg, tokens, positions, valid, lengths,
                          start + lengths, pages, block_tables,
                          attend_to_pages=True)
+
+
+def verify_step(
+    params: Params,
+    cfg: ModelConfig,
+    tokens: jnp.ndarray,
+    start: jnp.ndarray,
+    lengths: jnp.ndarray,
+    pages: KVPages,
+    block_tables: jnp.ndarray,
+) -> tuple[jnp.ndarray, KVPages]:
+    """Speculative-decode verify pass: score ``S`` candidate tokens at once.
+
+    Identical cache semantics to ``prefill_chunk`` (tokens land at absolute
+    positions ``start..start+lengths-1``, attention runs against the paged
+    prefix + the chunk itself) but returns the logits of **every** position,
+    [B, S, V] — position ``i``'s logits are the model's distribution for the
+    token *after* ``tokens[:, i]``.  The caller accepts the longest draft
+    prefix whose tokens match these distributions and advances
+    ``context_lens`` by the accepted count; K/V written for rejected
+    positions stays beyond ``context_lens`` and is masked out of every
+    later attention read, then overwritten when real tokens arrive — so
+    rejection needs no cache rollback.
+
+    In greedy acceptance (token must equal the argmax) any draft source is
+    correctness-neutral: the accepted prefix is exactly what step-by-step
+    greedy decode would have produced.
+    """
+    B, S = tokens.shape
+    offs = jnp.arange(S, dtype=jnp.int32)
+    positions = start[:, None] + offs[None, :]
+    valid = offs[None, :] < lengths[:, None]
+    return _prefill_impl(params, cfg, tokens, positions, valid, lengths,
+                         start + lengths, pages, block_tables,
+                         attend_to_pages=True, return_all_logits=True)
 
 
 # ---------------------------------------------------------------------------

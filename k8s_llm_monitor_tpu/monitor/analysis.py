@@ -180,7 +180,9 @@ class LocalEngineBackend(LLMBackend):
         engine = InferenceEngine(
             cfg,
             params,
-            EngineConfig(max_slots=tpu_cfg.max_batch, num_blocks=tpu_cfg.kv_blocks),
+            EngineConfig(max_slots=tpu_cfg.max_batch,
+                         num_blocks=tpu_cfg.kv_blocks,
+                         spec_k=tpu_cfg.spec_k),
             tokenizer=tokenizer,
             mesh=mesh,
         )

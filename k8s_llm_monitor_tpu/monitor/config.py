@@ -52,6 +52,10 @@ class TPULLMConfig:
     # Persistent XLA compilation cache: warm server restarts skip the
     # multi-minute prefill/decode compile ladder.  '' disables.
     compile_cache_dir: str = ".jax_cache"
+    # Prompt-lookup speculative decoding draft length (serving/spec.py);
+    # 0 disables.  Greedy requests emit up to spec_k+1 tokens per verify
+    # forward when the output quotes its context (diagnosis answers do).
+    spec_k: int = 0
 
 
 @dataclass

@@ -39,10 +39,14 @@ def init_multihost(coordinator: str | None = None,
     ``create_mesh`` preserves that ordering because jax.devices()
     enumerates local devices contiguously per process.
     """
+    import logging
     import os
 
-    if jax.process_count() > 1:
-        return jax.process_index()  # already initialized by the runtime
+    # Must not touch any API that initializes the XLA backend before
+    # initialize() — jax.process_count() does, after which initialize()
+    # raises unconditionally.  is_initialized() only reads client state.
+    if jax.distributed.is_initialized():
+        return jax.process_index()
     coordinator = coordinator or os.environ.get("JAX_COORDINATOR")
     num_processes = num_processes or int(os.environ.get("NUM_PROCESSES", 0))
     process_id = (process_id if process_id is not None
@@ -56,8 +60,10 @@ def init_multihost(coordinator: str | None = None,
     else:
         try:
             jax.distributed.initialize()  # env/metadata-driven (TPU VM)
-        except Exception:  # noqa: BLE001 — single-host runs stay single
-            pass
+        except Exception as exc:  # noqa: BLE001 — single-host runs stay single
+            logging.getLogger("k8s_llm_monitor_tpu.parallel").debug(
+                "jax.distributed.initialize() not applicable (%s); "
+                "continuing single-host", exc)
     return jax.process_index()
 
 

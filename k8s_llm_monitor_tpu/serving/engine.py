@@ -67,6 +67,7 @@ from k8s_llm_monitor_tpu.serving.kv_cache import (
     OutOfBlocks,
     PrefixCache,
 )
+from k8s_llm_monitor_tpu.serving.spec import accept_greedy, propose_drafts
 
 
 @dataclasses.dataclass
@@ -127,6 +128,21 @@ class EngineConfig:
     # every waiting first token.  N bounds decode starvation for lanes
     # already generating.  1 = strict alternation, large = prefill-first.
     decode_every_n_chunk_rounds: int = 3
+    # Prompt-lookup speculative decoding (serving/spec.py): draft length per
+    # verify pass; 0 disables.  Greedy-only — a dispatch with any sampled
+    # lane falls back to the fused scan program.  Decode throughput rises
+    # toward (spec_k+1)x when outputs quote their context (the diagnosis
+    # workload: answers cite pod names / events / metric lines verbatim)
+    # because a verify pass costs the same weight traffic as one decode
+    # step.  Tradeoff: emission per call is data-dependent, so spec
+    # dispatches reconcile the pipeline first (no decode dispatch-ahead).
+    spec_k: int = 0
+    # Verify rounds fused into one spec dispatch (device-side scan) — the
+    # host-sync amortization knob, the spec analogue of decode_steps_per_iter.
+    spec_rounds_per_iter: int = 4
+    # History window for n-gram matching, per lane (tokens; rounded down to
+    # the per-seq capacity).  [max_slots, cap] int32 is KBs, not MBs.
+    spec_hist_cap: int = 4096
 
 
 class _Slot:
@@ -307,7 +323,22 @@ class InferenceEngine:
             _prefill_chunk_greedy_fn, donate_argnums=(4,))
         self._place_tokens = jax.jit(_place_fn, donate_argnums=(0,))
         # Fused-decode programs, built lazily per (n_steps, sampled).
-        self._decode_cache: dict[tuple[int, bool], Any] = {}
+        self._decode_cache: dict[tuple, Any] = {}
+
+        # Speculative decoding state: per-lane token history for the n-gram
+        # proposer.  Rows are (re)written whole at admission, then extended
+        # in-program as tokens are accepted.
+        if ec.spec_k > 0:
+            H = min(self.capacity_tokens, ec.spec_hist_cap)
+            self._hist = jnp.full((ec.max_slots, H), -1, jnp.int32)
+            self._hist_place = jax.jit(
+                lambda h, rows, idx: h.at[idx].set(rows, mode="drop"),
+                donate_argnums=(0,))
+        else:
+            self._hist = None
+            self._hist_place = None
+        self.spec_tokens = 0         # tokens emitted by spec dispatches
+        self.spec_verify_steps = 0   # verify forwards those tokens cost
 
         self._rng = jax.random.PRNGKey(seed)
         self._tok_state = jnp.zeros((ec.max_slots,), jnp.int32)
@@ -514,6 +545,26 @@ class InferenceEngine:
                 np.zeros((P,), np.int32),
                 np.ones((P,), np.float32))
 
+    def _write_hist(self, entries: list[tuple[int, GenerationRequest]]) -> None:
+        """Load prompt tokens into the speculation history rows of freshly
+        occupied slots (one batched scatter).  Prompts longer than the
+        window keep their head — matches past the window just stop
+        proposing, which degrades acceptance, never correctness."""
+        if self._hist is None or not entries:
+            return
+        H = self._hist.shape[1]
+        # Fixed row counts (1 or the admission lane max) keep the compile
+        # cache at two entries; padding rows carry idx == max_slots (drop).
+        P = 1 if len(entries) == 1 else self.ecfg.max_prefills_per_step
+        rows = np.full((P, H), -1, np.int32)
+        idx = np.full((P,), self.ecfg.max_slots, np.int32)
+        for j, (slot_idx, req) in enumerate(entries):
+            L = min(len(req.prompt_ids), H)
+            rows[j, :L] = req.prompt_ids[:L]
+            idx[j] = slot_idx
+        self._hist = self._hist_place(
+            self._hist, jnp.asarray(rows), jnp.asarray(idx))
+
     def _ensure_free(self, num_tokens: int) -> bool:
         """Make room for ``num_tokens`` of new blocks, evicting LRU prefix
         cache entries if needed.  Eviction drops the cache's reference; a
@@ -579,7 +630,9 @@ class InferenceEngine:
                 slot.ctx_len = L
                 slot.prefill_pos = shared_toks
                 slot.prefilling = True
-                self._slots[free.pop(0)] = slot
+                slot_idx = free.pop(0)
+                self._slots[slot_idx] = slot
+                self._write_hist([(slot_idx, req)])
                 admitted_long += 1
                 continue
             batch.append((free.pop(0), req, blocks, shared_toks))
@@ -736,6 +789,7 @@ class InferenceEngine:
             self._slots[slot_idx] = slot
             lanes.append((slot_idx, req))
         self.prefills += len(batch)
+        self._write_hist(lanes)
         self._queue_inflight("admit", first, idx, lanes)
 
     # -- decode ---------------------------------------------------------
@@ -818,6 +872,80 @@ class InferenceEngine:
         self._decode_cache[key] = prog
         return prog
 
+    def _spec_program(self, k: int, rounds: int):
+        """Build (and cache) the fused speculative-decode program.
+
+        Each scanned round, entirely on device: write the current token into
+        the history row, propose ``k`` draft tokens by n-gram lookup
+        (serving/spec.py), verify all ``k+1`` positions in one forward
+        (llama.verify_step), accept the longest argmax-matching prefix plus
+        the model's correction token, and advance ctx by the accepted count.
+        Rejected positions' K/V stays beyond context_lens — masked, then
+        overwritten — so there is no rollback.  Greedy-only and
+        bit-identical to the sequential path by construction.
+
+        Returns (toks [rounds*(k+1), B] with -1 padding, tok_state, pages,
+        hist, n_verify) where n_verify counts rounds that actually ran a
+        forward (all lanes done => remaining rounds are masked no-ops but
+        still traced; they count only while any lane was active).
+        """
+        key = ("spec", k, rounds)
+        prog = self._decode_cache.get(key)
+        if prog is not None:
+            return prog
+
+        cfg = self.cfg
+        H = self._hist.shape[1]
+
+        def fn(params, tok_state, ctx, quota, pages, tables, hist, eos):
+            active0 = ctx > 0
+            B = tok_state.shape[0]
+            lane = jnp.arange(B, dtype=jnp.int32)
+
+            def body(carry, _):
+                tok, ctx, quota, done, pages, hist = carry
+                act = active0 & ~done & (quota > 0)
+                # Current token enters history at its own position (writes
+                # at/after H, or by inactive lanes, are dropped).
+                wcol = jnp.where(act & (ctx < H), ctx, H)
+                hist = hist.at[lane, wcol].set(tok, mode="drop")
+                drafts = propose_drafts(hist, ctx, tok, k)
+                toks_in = jnp.concatenate([tok[:, None], drafts], axis=1)
+                lengths = jnp.where(act, k + 1, 0).astype(jnp.int32)
+                logits, pages = llama.verify_step(
+                    params, cfg, toks_in, ctx, lengths, pages, tables)
+                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                emit, out = accept_greedy(greedy, drafts, quota, act, eos)
+                # Accepted tokens extend the history at ctx+1+i.  Padding
+                # (-1) columns are redirected to H and dropped.
+                cols = (ctx[:, None] + 1
+                        + jnp.arange(k + 1, dtype=jnp.int32)[None, :])
+                cols = jnp.where((out >= 0) & (cols < H), cols, H)
+                hist = hist.at[lane[:, None], cols].set(out, mode="drop")
+                last = jnp.take_along_axis(
+                    greedy, jnp.maximum(emit - 1, 0)[:, None], axis=1)[:, 0]
+                tok = jnp.where(act & (emit > 0), last, tok)
+                # out's -1 padding must not match an unset eos_id of -1.
+                done = done | (act & jnp.any((out == eos) & (out >= 0), 1))
+                ctx = ctx + jnp.where(act, emit, 0)
+                quota = quota - jnp.where(act, emit, 0)
+                return ((tok, ctx, quota, done, pages, hist),
+                        (out, jnp.any(act).astype(jnp.int32)))
+
+            done0 = jnp.zeros_like(active0)
+            carry, (outs, ran) = jax.lax.scan(
+                body, (tok_state, ctx, quota, done0, pages, hist),
+                None, length=rounds)
+            tok_state, _, _, _, pages, hist = carry
+            # [R, B, k+1] -> [R*(k+1), B]: chronological per lane, matching
+            # the reconcile contract of the fused decode program.
+            toks = jnp.transpose(outs, (0, 2, 1)).reshape(rounds * (k + 1), B)
+            return toks, tok_state, pages, hist, jnp.sum(ran)
+
+        prog = jax.jit(fn, donate_argnums=(1, 4, 6))
+        self._decode_cache[key] = prog
+        return prog
+
     def _dispatch_decode(self) -> bool:
         """Dispatch one fused decode call over lanes with predicted budget.
         Returns True if a call was dispatched."""
@@ -841,9 +969,40 @@ class InferenceEngine:
         if not lanes:
             return False
 
-        kmax = min(ec.decode_steps_per_iter,
-                   max(s.remaining_pred for _, s in lanes))
-        K = 1 << (kmax.bit_length() - 1)
+        if any(c.kind == "spec" for c in self._inflight):
+            # A spec call's emission is data-dependent, so ctx_pred for its
+            # lanes is an upper bound while it is in flight.  ANY follow-up
+            # decode dispatch (spec or not — a sampled admission can flip
+            # the batch to the fused path) must wait for reconciled ctx, or
+            # it would run lanes at inflated positions whose attention
+            # window covers rejected-draft KV.
+            self._reconcile_all()
+            lanes = [(i, s) for i, s in enumerate(self._slots)
+                     if s is not None and not s.retired and not s.prefilling
+                     and s.remaining_pred > 0 and not s.cancel_requested]
+            if not lanes:
+                return False
+
+        spec = (ec.spec_k > 0
+                and all(s.req.sampling.temperature <= 0.0 for _, s in lanes))
+        if spec:
+            # Emission per spec call is data-dependent (1..k+1 per round),
+            # so a dispatch-ahead call would run with an overestimated ctx
+            # and read unmasked garbage.  Drain the pipeline first: spec
+            # trades dispatch-ahead depth for multi-token verify rounds.
+            self._reconcile_all()
+            lanes = [(i, s) for i, s in enumerate(self._slots)
+                     if s is not None and not s.retired and not s.prefilling
+                     and s.remaining_pred > 0 and not s.cancel_requested]
+            if not lanes:
+                return False
+            # Per-lane quota: the most a call can emit if every round
+            # accepts the full draft.
+            K = ec.spec_rounds_per_iter * (ec.spec_k + 1)
+        else:
+            kmax = min(ec.decode_steps_per_iter,
+                       max(s.remaining_pred for _, s in lanes))
+            K = 1 << (kmax.bit_length() - 1)
 
         # Ensure pages for each lane's next min(K, remaining) KV writes.  On
         # pressure, drain speculation (so preemption sees reconciled state)
@@ -908,12 +1067,24 @@ class InferenceEngine:
 
         eos = jnp.asarray(self.eos_id, jnp.int32)
         all_greedy = all(s.req.sampling.temperature <= 0.0 for _, s in lanes)
-        if all_greedy:
+        if spec:
+            prog = self._spec_program(ec.spec_k, ec.spec_rounds_per_iter)
+            toks, self._tok_state, self.pages, self._hist, nver = prog(
+                self.params, self._tok_state, jnp.asarray(ctx),
+                jnp.asarray(steps_arr), self.pages, jnp.asarray(table),
+                self._hist, eos,
+            )
+            payload: Any = (toks, nver)
+            kind = "spec"
+        elif all_greedy:
             prog = self._decode_program(K, sampled=False)
             toks, self._tok_state, self.pages = prog(
                 self.params, self._tok_state, jnp.asarray(ctx),
                 jnp.asarray(steps_arr), self.pages, jnp.asarray(table), eos,
             )
+            payload = toks
+            kind = "decode"
+            self.steps += K
         else:
             prog = self._decode_program(K, sampled=True)
             self._rng, sub = jax.random.split(self._rng)
@@ -923,13 +1094,15 @@ class InferenceEngine:
                 jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
                 sub, eos,
             )
+            payload = toks
+            kind = "decode"
+            self.steps += K
         try:
             toks.copy_to_host_async()
         except AttributeError:
             pass
-        self.steps += K
         self._inflight.append(_Inflight(
-            kind="decode", call_id=self._next_call_id, arr=toks, lanes=meta))
+            kind=kind, call_id=self._next_call_id, arr=payload, lanes=meta))
         self._next_call_id += 1
         return True
 
@@ -937,7 +1110,14 @@ class InferenceEngine:
 
     def _reconcile_one(self) -> None:
         call = self._inflight.popleft()
-        arr = np.asarray(call.arr)
+        if call.kind == "spec":
+            toks, nver = call.arr
+            arr = np.asarray(toks)
+            ran = int(nver)
+            self.spec_verify_steps += ran
+            self.steps += ran
+        else:
+            arr = np.asarray(call.arr)
         if call.kind in ("admit", "chunk"):
             now = time.monotonic()
             for s in call.touched:           # chunk calls: drain refcounts
@@ -965,6 +1145,8 @@ class InferenceEngine:
                     continue  # lane EOSed in an earlier call; discard zombies
                 new = [int(t) for t in arr[:, slot_idx] if t >= 0]
                 s.inflight_decode -= steps_i
+                if call.kind == "spec":
+                    self.spec_tokens += len(new)
                 if not new:
                     continue
                 s.ctx_len += len(new)

@@ -1,0 +1,129 @@
+"""Prompt-lookup speculative decoding: device-side draft proposal + acceptance.
+
+Diagnosis answers quote the evidence block that dominates their prompt
+(pod names, event messages, metric lines), so the next tokens of the output
+are very often a verbatim continuation of an n-gram that already appeared
+in the context.  Prompt-lookup speculation (Saxena 2023; the technique
+behind HF's ``prompt_lookup_num_tokens`` and vLLM's ``[ngram]`` speculator)
+exploits that without a draft model: match the tail of the sequence against
+its own history, propose the K tokens that followed the match, and verify
+all K+1 positions in one forward pass.
+
+Everything here is static-shaped jnp so the whole speculation loop — match,
+propose, verify, accept — runs inside the engine's jitted program with no
+host round-trip.  The TPU-friendly trick is that matching is a vectorized
+compare over the [B, H] history buffer (one VPU sweep), not a hash-table
+probe like the CPU implementations: H is a few thousand, so the sweep is
+noise next to the verify matmuls.
+
+Correctness does not depend on draft quality anywhere: greedy acceptance
+(``accept_greedy``) emits the longest draft prefix that equals the argmax
+chain, which is by construction exactly what one-token-at-a-time greedy
+decode would have emitted — a garbage draft just means fewer accepted
+tokens, never wrong ones.  (Reference counterpart: none — the reference's
+LLM layer is config-only, internal/config/config.go:141-145; this is a
+serving-throughput extension the TPU engine gets because verify FLOPs are
+free under the decode weight-bandwidth ceiling.)
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def propose_drafts(
+    hist: jnp.ndarray,
+    ctx: jnp.ndarray,
+    cur_tok: jnp.ndarray,
+    k: int,
+) -> jnp.ndarray:
+    """Propose ``k`` draft tokens per lane by n-gram lookup over ``hist``.
+
+    Args:
+      hist: [B, H] int32 token history; positions ``0..ctx`` are valid
+        (``hist[b, ctx[b]]`` must already hold ``cur_tok[b]``), the rest is
+        stale garbage from earlier requests in the slot (harmless: matches
+        are masked to ``p <= ctx``).
+      ctx: [B] int32 position of the current (last known) token.
+      cur_tok: [B] int32 the current token — the one the next forward feeds.
+      k: draft length (static).
+
+    Returns:
+      [B, k] int32 draft tokens.  Lanes with no match get whatever follows
+      position 0 — garbage-safe under greedy acceptance.
+
+    A 3-gram match (last three tokens) is preferred over a 2-gram match:
+    longer context keys have far better continuation precision, which is
+    what sets the acceptance rate; the 2-gram fallback keeps short outputs
+    speculating.  Both are computed in one pass and selected per lane.
+    """
+    B, H = hist.shape
+    pos = jnp.arange(H, dtype=jnp.int32)[None, :]                  # [1, H]
+    safe = lambda i: jnp.clip(i, 0, H - 1)
+    prev1 = jnp.take_along_axis(hist, safe(ctx - 1)[:, None], 1)[:, 0]
+    prev2 = jnp.take_along_axis(hist, safe(ctx - 2)[:, None], 1)[:, 0]
+
+    # m2[b, p]: positions whose (p-1, p) tokens equal the lane's last two.
+    # The match must end strictly before ctx so its continuation is history.
+    in_range = (pos >= 1) & (pos < ctx[:, None])
+    m2 = in_range & (hist == cur_tok[:, None])
+    m2 = m2 & (jnp.roll(hist, 1, axis=1) == prev1[:, None])
+    m3 = m2 & (pos >= 2) & (jnp.roll(hist, 2, axis=1) == prev2[:, None])
+    m3 = m3 & (ctx[:, None] >= 2)
+
+    # Latest match wins (recency beats earlier occurrences for code/log
+    # text); 0 doubles as the no-match sentinel — its continuation is just
+    # a garbage draft, which greedy acceptance scores as 0 accepted.
+    p3 = jnp.max(jnp.where(m3, pos, 0), axis=1)
+    p2 = jnp.max(jnp.where(m2, pos, 0), axis=1)
+    p = jnp.where(p3 > 0, p3, p2)                                  # [B]
+
+    gather_idx = safe(p[:, None] + 1 + jnp.arange(k, dtype=jnp.int32)[None, :])
+    return jnp.take_along_axis(hist, gather_idx, axis=1)           # [B, k]
+
+
+def accept_greedy(
+    greedy: jnp.ndarray,
+    drafts: jnp.ndarray,
+    quota: jnp.ndarray,
+    active: jnp.ndarray,
+    eos_id: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Greedy acceptance over one verify pass.
+
+    Args:
+      greedy: [B, K+1] int32 argmax of the verify logits — ``greedy[:, i]``
+        is the model's token *after* fed position ``i``.
+      drafts: [B, K] int32 the proposed tokens that were fed at positions
+        ``1..K`` of the verify chunk.
+      quota: [B] int32 max tokens this lane may still emit (budget).
+      active: [B] bool lanes participating this round.
+      eos_id: scalar int32.
+
+    Returns:
+      (emit [B] int32 — number of tokens emitted, 0 for inactive lanes;
+       out [B, K+1] int32 — emitted tokens left-packed, -1 elsewhere).
+
+    The emitted sequence per lane is ``greedy[:, :emit]``: the accepted
+    draft prefix (where ``greedy[:, i] == drafts[:, i]``) plus the model's
+    one correction/bonus token, truncated to the quota and to the first
+    EOS.  Every emitted token equals what sequential greedy decode would
+    produce, so speculation is bit-identical to the non-speculative path.
+    """
+    B, K1 = greedy.shape
+    K = K1 - 1
+    iot = jnp.arange(K1, dtype=jnp.int32)[None, :]                 # [1, K+1]
+
+    matched = greedy[:, :K] == drafts                              # [B, K]
+    n_acc = jnp.sum(jnp.cumprod(matched.astype(jnp.int32), axis=1), axis=1)
+    emit = jnp.minimum(n_acc + 1, quota)
+
+    # Truncate after the first EOS that falls inside the emitted window.
+    is_eos = (greedy == eos_id) & (iot < emit[:, None])
+    any_eos = jnp.any(is_eos, axis=1)
+    first_eos = jnp.argmax(is_eos, axis=1).astype(jnp.int32)
+    emit = jnp.where(any_eos, first_eos + 1, emit)
+    emit = jnp.where(active, emit, 0)
+
+    out = jnp.where((iot < emit[:, None]) & active[:, None], greedy, -1)
+    return emit, out
