@@ -495,6 +495,63 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001 — extras never fail the bench
         log(f"long-prompt bench skipped: {exc}")
 
+    # --- speculative-decode leg: prompt-lookup speculation A/B ----------
+    # Decode-heavy shape (long generations, moderate concurrency) where
+    # weight streaming dominates; speculation turns one verify forward into
+    # up to spec_k+1 emitted tokens when the output continues an n-gram
+    # from its own context (serving/spec.py).  A/B on identical prompts.
+    spec_tok_s = spec_base_tok_s = spec_tpv = None
+    try:
+        import dataclasses as _dc
+
+        n_sp = int(os.environ.get("BENCH_SPEC_CONCURRENCY", "32"))
+        sp_gen = int(os.environ.get("BENCH_SPEC_MAX_TOKENS", "128"))
+        sp_cap = prompt_len + sp_gen + 16
+        sp_base = EngineConfig(
+            max_slots=32,
+            num_blocks=min(1400, 32 * ((sp_cap + 15) // 16) + 64),
+            block_size=16,
+            max_blocks_per_seq=(sp_cap + 15) // 16,
+            prefill_buckets=(bucket,),
+            max_prefills_per_step=8,
+            max_admission_rounds=4,
+            decode_steps_per_iter=8,
+        )
+        sp_prompts = [prompt() for _ in range(n_sp)]
+        for spec_on in (False, True):
+            se = InferenceEngine(
+                cfg, params,
+                _dc.replace(sp_base, spec_k=4 if spec_on else 0),
+                eos_id=-1)
+            se.generate([sp_prompts[0]] * 2, SamplingParams(max_tokens=8))
+            spt0 = time.monotonic()
+            for i, p in enumerate(sp_prompts):
+                se.submit(GenerationRequest(
+                    request_id=f"sp-{i}", prompt_ids=p,
+                    sampling=SamplingParams(max_tokens=sp_gen)))
+            while se.has_work:
+                se.step()
+            dt = time.monotonic() - spt0
+            spres = [se.poll(f"sp-{i}") for i in range(n_sp)]
+            assert all(r is not None and r.finish_reason != "error"
+                       for r in spres)
+            tput = sum(len(r.token_ids) for r in spres) / dt
+            if spec_on:
+                spec_tok_s = tput
+                # Per-lane acceptance: emitted tokens per (lane x verify
+                # round); 1.0 = no draft ever accepted, k+1 = all accepted.
+                spec_tpv = (se.spec_tokens / se.spec_lane_rounds
+                            if se.spec_lane_rounds else 0.0)
+                log(f"spec decode (k=4): {tput:.0f} tok/s, "
+                    f"{spec_tpv:.2f} accepted tokens/lane-round "
+                    f"(baseline {spec_base_tok_s:.0f} tok/s, "
+                    f"{tput / spec_base_tok_s:.2f}x)")
+            else:
+                spec_base_tok_s = tput
+            del se
+    except Exception as exc:  # noqa: BLE001 — extras never fail the bench
+        log(f"spec-decode leg skipped: {exc}")
+
     # BASELINE config #3: encoder embedding throughput (BGE-large geometry
     # on TPU, tiny on CPU smoke runs), via the anomaly detector's batch path.
     embed_docs_per_s = 0.0
@@ -564,6 +621,10 @@ def main() -> None:
         extras["w8a8_perchip_p50_ttft_ms"] = round(w8a8_perchip_p50_ms, 2)
     if w8a8_shared_p50_ms is not None:
         extras["w8a8_shared_prefix_p50_ttft_ms"] = round(w8a8_shared_p50_ms, 2)
+    if spec_tok_s is not None:
+        extras["spec_decode_tok_s"] = round(spec_tok_s, 1)
+        extras["spec_baseline_tok_s"] = round(spec_base_tok_s, 1)
+        extras["spec_accept_per_lane_round"] = round(spec_tpv, 2)
     log(f"total bench time {time.monotonic() - t0:.0f}s")
     print(json.dumps({
         "metric": "p50_ttft_100c_ms",

@@ -287,10 +287,15 @@ def _scatter_pages(
     """
     bs = pages.shape[1]
     B, S = positions.shape
-    blk_idx = positions // bs                        # [B, S] index into table
-    blk_idx = jnp.clip(blk_idx, 0, block_table.shape[1] - 1)
+    raw_blk = positions // bs                        # [B, S] index into table
+    blk_idx = jnp.clip(raw_blk, 0, block_table.shape[1] - 1)
     block_ids = jnp.take_along_axis(block_table, blk_idx, axis=1)  # [B, S]
-    block_ids = jnp.where(valid, block_ids, 0)
+    # Positions past the table redirect to the null block rather than
+    # clipping into the lane's LAST real block: a speculative verify at the
+    # capacity boundary writes rejected-draft K/V beyond the per-seq cap,
+    # and a clip would overwrite live cache there (silent wrong logits).
+    block_ids = jnp.where(valid & (raw_blk < block_table.shape[1]),
+                          block_ids, 0)
     offs = positions % bs
     flat_blocks = block_ids.reshape(-1)
     flat_offs = offs.reshape(-1)

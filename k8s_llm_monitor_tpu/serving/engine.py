@@ -339,6 +339,7 @@ class InferenceEngine:
             self._hist_place = None
         self.spec_tokens = 0         # tokens emitted by spec dispatches
         self.spec_verify_steps = 0   # verify forwards those tokens cost
+        self.spec_lane_rounds = 0    # sum of active lanes over those forwards
 
         self._rng = jax.random.PRNGKey(seed)
         self._tok_state = jnp.zeros((ec.max_slots,), jnp.int32)
@@ -929,22 +930,32 @@ class InferenceEngine:
                 done = done | (act & jnp.any((out == eos) & (out >= 0), 1))
                 ctx = ctx + jnp.where(act, emit, 0)
                 quota = quota - jnp.where(act, emit, 0)
-                return ((tok, ctx, quota, done, pages, hist),
-                        (out, jnp.any(act).astype(jnp.int32)))
+                # Stats row: [rounds that ran a forward, lane-rounds] — the
+                # latter divides spec_tokens into true per-lane acceptance.
+                stats = jnp.stack([jnp.any(act).astype(jnp.int32),
+                                   jnp.sum(act.astype(jnp.int32))])
+                return (tok, ctx, quota, done, pages, hist), (out, stats)
 
             done0 = jnp.zeros_like(active0)
-            carry, (outs, ran) = jax.lax.scan(
+            carry, (outs, stats) = jax.lax.scan(
                 body, (tok_state, ctx, quota, done0, pages, hist),
                 None, length=rounds)
             tok_state, _, _, _, pages, hist = carry
             # [R, B, k+1] -> [R*(k+1), B]: chronological per lane, matching
             # the reconcile contract of the fused decode program.
             toks = jnp.transpose(outs, (0, 2, 1)).reshape(rounds * (k + 1), B)
-            return toks, tok_state, pages, hist, jnp.sum(ran)
+            return toks, tok_state, pages, hist, jnp.sum(stats, axis=0)
 
         prog = jax.jit(fn, donate_argnums=(1, 4, 6))
         self._decode_cache[key] = prog
         return prog
+
+    def _decode_lanes(self) -> list[tuple[int, "_Slot"]]:
+        """Slots eligible for a decode dispatch right now.  Recomputed after
+        any reconcile/preemption point that can retire or admit slots."""
+        return [(i, s) for i, s in enumerate(self._slots)
+                if s is not None and not s.retired and not s.prefilling
+                and s.remaining_pred > 0 and not s.cancel_requested]
 
     def _dispatch_decode(self) -> bool:
         """Dispatch one fused decode call over lanes with predicted budget.
@@ -963,9 +974,7 @@ class InferenceEngine:
                     and (s.prefilling or not s.pending_admit)):
                 self._retire(i)
 
-        lanes = [(i, s) for i, s in enumerate(self._slots)
-                 if s is not None and s.remaining_pred > 0
-                 and not s.prefilling and not s.cancel_requested]
+        lanes = self._decode_lanes()
         if not lanes:
             return False
 
@@ -977,9 +986,7 @@ class InferenceEngine:
             # it would run lanes at inflated positions whose attention
             # window covers rejected-draft KV.
             self._reconcile_all()
-            lanes = [(i, s) for i, s in enumerate(self._slots)
-                     if s is not None and not s.retired and not s.prefilling
-                     and s.remaining_pred > 0 and not s.cancel_requested]
+            lanes = self._decode_lanes()
             if not lanes:
                 return False
 
@@ -990,12 +997,11 @@ class InferenceEngine:
             # so a dispatch-ahead call would run with an overestimated ctx
             # and read unmasked garbage.  Drain the pipeline first: spec
             # trades dispatch-ahead depth for multi-token verify rounds.
-            self._reconcile_all()
-            lanes = [(i, s) for i, s in enumerate(self._slots)
-                     if s is not None and not s.retired and not s.prefilling
-                     and s.remaining_pred > 0 and not s.cancel_requested]
-            if not lanes:
-                return False
+            if self._inflight:
+                self._reconcile_all()
+                lanes = self._decode_lanes()
+                if not lanes:
+                    return False
             # Per-lane quota: the most a call can emit if every round
             # accepts the full draft.
             K = ec.spec_rounds_per_iter * (ec.spec_k + 1)
@@ -1040,9 +1046,7 @@ class InferenceEngine:
                         if victim == i:
                             break
 
-        lanes = [(i, s) for i, s in enumerate(self._slots)
-                 if s is not None and not s.retired and not s.prefilling
-                 and s.remaining_pred > 0 and not s.cancel_requested]
+        lanes = self._decode_lanes()
         if not lanes:
             return False
 
@@ -1111,10 +1115,11 @@ class InferenceEngine:
     def _reconcile_one(self) -> None:
         call = self._inflight.popleft()
         if call.kind == "spec":
-            toks, nver = call.arr
+            toks, stats = call.arr
             arr = np.asarray(toks)
-            ran = int(nver)
+            ran, lane_rounds = (int(x) for x in np.asarray(stats))
             self.spec_verify_steps += ran
+            self.spec_lane_rounds += lane_rounds
             self.steps += ran
         else:
             arr = np.asarray(call.arr)

@@ -309,6 +309,46 @@ def test_spec_under_page_pressure(params):
         assert r.token_ids == _naive_greedy(params, p, 16)
 
 
+def test_scatter_beyond_table_hits_null_block():
+    """K/V writes at positions past the block table's reach must land in
+    the null block 0, NOT clip into the lane's last real block: the spec
+    verify pass at the capacity boundary writes rejected-draft K/V there,
+    and a clip would overwrite live cache (silent logit corruption)."""
+    bs, width = 4, 2
+    pages = jnp.full((6, bs, 8), 7.0, jnp.float32)   # sentinel everywhere
+    table = jnp.asarray([[1, 2]], jnp.int32)         # capacity = 8 positions
+    vals = jnp.ones((1, 4, 2, 4), jnp.float32)       # [B, S, KVH, D]
+    positions = jnp.asarray([[6, 7, 8, 9]], jnp.int32)  # 8, 9 overflow
+    valid = jnp.ones((1, 4), bool)
+    out = llama._scatter_pages(pages, vals, table, positions, valid)
+    out = np.asarray(out)
+    # In-range writes land in block 2 (positions 6, 7 -> offsets 2, 3).
+    assert (out[2, 2:] == 1.0).all()
+    # Overflow went to the null block, and block 2's offsets 0-1 (where a
+    # clip of positions 8, 9 would land) still hold the sentinel.
+    assert (out[0, :2] == 1.0).all()
+    assert (out[2, :2] == 7.0).all(), "overflow clipped into a real block"
+    assert (out[1] == 7.0).all()
+
+
+def test_spec_at_capacity_boundary(params):
+    """A request whose prompt+max_tokens exactly fills its per-seq capacity
+    makes the verify pass write rejected drafts past the last block; those
+    writes must fall into the null block, not clip back into the lane's
+    real cache (which silently corrupts live KV and breaks bit-identity)."""
+    eng = _spec_engine(params, spec_k=4, rounds=2,
+                       max_blocks_per_seq=4, num_blocks=32,
+                       prefill_buckets=(16,))
+    cap = eng.capacity_tokens                     # 4 blocks x 8 = 32 tokens
+    rng = np.random.default_rng(23)
+    n_gen = 12
+    prompt = list(rng.integers(3, 300, size=cap - n_gen))
+    results = eng.generate([prompt],
+                           SamplingParams(max_tokens=n_gen, temperature=0.0))
+    assert results[0].token_ids == _naive_greedy(params, prompt, n_gen), \
+        "KV corrupted by out-of-capacity draft writes"
+
+
 def test_spec_long_prompt_chunked_admission(params):
     """Prompts beyond the largest bucket stream through chunked prefill;
     their generation must still match under speculation."""
