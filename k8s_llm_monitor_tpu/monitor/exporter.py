@@ -71,6 +71,16 @@ def _engine_metrics(w: _Writer, engine) -> None:
     w.metric("engine_preemptions_total", "counter",
              "Recompute-preemptions under KV pressure",
              [("", engine.preemptions)])
+    w.metric("engine_spec_tokens_total", "counter",
+             "Tokens emitted by speculative-decode dispatches",
+             [("", engine.spec_tokens)])
+    w.metric("engine_spec_verify_steps_total", "counter",
+             "Verify forwards run by speculative-decode dispatches",
+             [("", engine.spec_verify_steps)])
+    w.metric("engine_spec_lane_rounds_total", "counter",
+             "Active lane-rounds across spec verify forwards (divide "
+             "spec_tokens by this for per-lane acceptance)",
+             [("", engine.spec_lane_rounds)])
 
     # Prometheus histogram: cumulative buckets + sum + count.
     cumulative = 0

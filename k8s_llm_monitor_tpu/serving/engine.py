@@ -67,7 +67,11 @@ from k8s_llm_monitor_tpu.serving.kv_cache import (
     OutOfBlocks,
     PrefixCache,
 )
-from k8s_llm_monitor_tpu.serving.spec import accept_greedy, propose_drafts
+from k8s_llm_monitor_tpu.serving.spec import (
+    accept_greedy,
+    accept_sampled,
+    propose_drafts,
+)
 
 
 @dataclasses.dataclass
@@ -873,24 +877,28 @@ class InferenceEngine:
         self._decode_cache[key] = prog
         return prog
 
-    def _spec_program(self, k: int, rounds: int):
+    def _spec_program(self, k: int, rounds: int, sampled: bool):
         """Build (and cache) the fused speculative-decode program.
 
         Each scanned round, entirely on device: write the current token into
         the history row, propose ``k`` draft tokens by n-gram lookup
         (serving/spec.py), verify all ``k+1`` positions in one forward
-        (llama.verify_step), accept the longest argmax-matching prefix plus
-        the model's correction token, and advance ctx by the accepted count.
+        (llama.verify_step), accept a draft prefix plus the model's
+        correction/bonus token, and advance ctx by the accepted count.
         Rejected positions' K/V stays beyond context_lens — masked, then
-        overwritten — so there is no rollback.  Greedy-only and
-        bit-identical to the sequential path by construction.
+        overwritten — so there is no rollback.
+
+        ``sampled=False``: argmax acceptance, bit-identical to the
+        sequential greedy path.  ``sampled=True``: the delta-draft
+        speculative-sampling rule (spec.accept_sampled), distribution-exact
+        for pure-temperature lanes and handling greedy lanes in the same
+        call; requires every lane to have top-k/top-p disabled (the
+        dispatcher guarantees it).
 
         Returns (toks [rounds*(k+1), B] with -1 padding, tok_state, pages,
-        hist, n_verify) where n_verify counts rounds that actually ran a
-        forward (all lanes done => remaining rounds are masked no-ops but
-        still traced; they count only while any lane was active).
+        hist, stats [2] = [verify rounds run, lane-rounds run]).
         """
-        key = ("spec", k, rounds)
+        key = ("spec", k, rounds, sampled)
         prog = self._decode_cache.get(key)
         if prog is not None:
             return prog
@@ -898,13 +906,14 @@ class InferenceEngine:
         cfg = self.cfg
         H = self._hist.shape[1]
 
-        def fn(params, tok_state, ctx, quota, pages, tables, hist, eos):
+        def fn(params, tok_state, ctx, quota, pages, tables, hist, temp,
+               rng, eos):
             active0 = ctx > 0
             B = tok_state.shape[0]
             lane = jnp.arange(B, dtype=jnp.int32)
 
             def body(carry, _):
-                tok, ctx, quota, done, pages, hist = carry
+                tok, ctx, quota, done, rng, pages, hist = carry
                 act = active0 & ~done & (quota > 0)
                 # Current token enters history at its own position (writes
                 # at/after H, or by inactive lanes, are dropped).
@@ -915,8 +924,13 @@ class InferenceEngine:
                 lengths = jnp.where(act, k + 1, 0).astype(jnp.int32)
                 logits, pages = llama.verify_step(
                     params, cfg, toks_in, ctx, lengths, pages, tables)
-                greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                emit, out = accept_greedy(greedy, drafts, quota, act, eos)
+                if sampled:
+                    rng, sub = jax.random.split(rng)
+                    emit, out = accept_sampled(
+                        sub, logits, drafts, quota, act, eos, temp)
+                else:
+                    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    emit, out = accept_greedy(greedy, drafts, quota, act, eos)
                 # Accepted tokens extend the history at ctx+1+i.  Padding
                 # (-1) columns are redirected to H and dropped.
                 cols = (ctx[:, None] + 1
@@ -924,7 +938,7 @@ class InferenceEngine:
                 cols = jnp.where((out >= 0) & (cols < H), cols, H)
                 hist = hist.at[lane[:, None], cols].set(out, mode="drop")
                 last = jnp.take_along_axis(
-                    greedy, jnp.maximum(emit - 1, 0)[:, None], axis=1)[:, 0]
+                    out, jnp.maximum(emit - 1, 0)[:, None], axis=1)[:, 0]
                 tok = jnp.where(act & (emit > 0), last, tok)
                 # out's -1 padding must not match an unset eos_id of -1.
                 done = done | (act & jnp.any((out == eos) & (out >= 0), 1))
@@ -934,13 +948,13 @@ class InferenceEngine:
                 # latter divides spec_tokens into true per-lane acceptance.
                 stats = jnp.stack([jnp.any(act).astype(jnp.int32),
                                    jnp.sum(act.astype(jnp.int32))])
-                return (tok, ctx, quota, done, pages, hist), (out, stats)
+                return (tok, ctx, quota, done, rng, pages, hist), (out, stats)
 
             done0 = jnp.zeros_like(active0)
             carry, (outs, stats) = jax.lax.scan(
-                body, (tok_state, ctx, quota, done0, pages, hist),
+                body, (tok_state, ctx, quota, done0, rng, pages, hist),
                 None, length=rounds)
-            tok_state, _, _, _, pages, hist = carry
+            tok_state, _, _, _, _, pages, hist = carry
             # [R, B, k+1] -> [R*(k+1), B]: chronological per lane, matching
             # the reconcile contract of the fused decode program.
             toks = jnp.transpose(outs, (0, 2, 1)).reshape(rounds * (k + 1), B)
@@ -990,8 +1004,15 @@ class InferenceEngine:
             if not lanes:
                 return False
 
-        spec = (ec.spec_k > 0
-                and all(s.req.sampling.temperature <= 0.0 for _, s in lanes))
+        def _spec_ok(s: _Slot) -> bool:
+            # Greedy always; sampled lanes only when pure-temperature —
+            # the delta-draft acceptance rule is exact for the plain
+            # softmax distribution, and top-k/top-p reshape it.
+            sp = s.req.sampling
+            return (sp.temperature <= 0.0
+                    or (sp.top_k <= 0 and sp.top_p >= 1.0))
+
+        spec = ec.spec_k > 0 and all(_spec_ok(s) for _, s in lanes)
         if spec:
             # Emission per spec call is data-dependent (1..k+1 per round),
             # so a dispatch-ahead call would run with an overestimated ctx
@@ -1072,11 +1093,13 @@ class InferenceEngine:
         eos = jnp.asarray(self.eos_id, jnp.int32)
         all_greedy = all(s.req.sampling.temperature <= 0.0 for _, s in lanes)
         if spec:
-            prog = self._spec_program(ec.spec_k, ec.spec_rounds_per_iter)
+            prog = self._spec_program(ec.spec_k, ec.spec_rounds_per_iter,
+                                      sampled=not all_greedy)
+            self._rng, sub = jax.random.split(self._rng)
             toks, self._tok_state, self.pages, self._hist, nver = prog(
                 self.params, self._tok_state, jnp.asarray(ctx),
                 jnp.asarray(steps_arr), self.pages, jnp.asarray(table),
-                self._hist, eos,
+                self._hist, jnp.asarray(temp), sub, eos,
             )
             payload: Any = (toks, nver)
             kind = "spec"

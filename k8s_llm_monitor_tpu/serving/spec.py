@@ -1,5 +1,19 @@
 """Prompt-lookup speculative decoding: device-side draft proposal + acceptance.
 
+Two acceptance rules live here:
+
+  * ``accept_greedy`` — argmax verification.  Bit-identical to sequential
+    greedy decode for ANY draft (see below).
+  * ``accept_sampled`` — exact speculative *sampling* for pure-temperature
+    lanes (top-k/top-p off — the diagnosis default is temperature 0.1 with
+    both filters disabled).  A prompt-lookup draft is a delta distribution
+    q = 1{x}, so the canonical accept rule min(1, p(x)/q(x)) reduces to
+    "accept x with probability p(x)", and the rejection residual
+    norm((p-q)+) reduces to p with x zeroed, renormalized.  Marginal check:
+    P(t) = p(x)·1{t=x} + (1-p(x))·p(t)/(1-p(x))·1{t≠x} = p(t) — the output
+    distribution is exactly the target's at every position, so sampled
+    speculation changes the rng *stream* but not the statistics.
+
 Diagnosis answers quote the evidence block that dominates their prompt
 (pod names, event messages, metric lines), so the next tokens of the output
 are very often a verbatim continuation of an n-gram that already appeared
@@ -28,6 +42,7 @@ free under the decode weight-bandwidth ceiling.)
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
@@ -79,7 +94,12 @@ def propose_drafts(
     p = jnp.where(p3 > 0, p3, p2)                                  # [B]
 
     gather_idx = safe(p[:, None] + 1 + jnp.arange(k, dtype=jnp.int32)[None, :])
-    return jnp.take_along_axis(hist, gather_idx, axis=1)           # [B, k]
+    drafts = jnp.take_along_axis(hist, gather_idx, axis=1)         # [B, k]
+    # The -1 history padding is not a token id: fed to the verify embed it
+    # would wrap to vocab row V-1, and sampled acceptance could then accept
+    # and emit -1 (the reconcile padding sentinel) with p(V-1) probability.
+    # Token 0 is an ordinary (never-matching-argmax, low-p) vocab id.
+    return jnp.maximum(drafts, 0)
 
 
 def accept_greedy(
@@ -126,4 +146,86 @@ def accept_greedy(
     emit = jnp.where(active, emit, 0)
 
     out = jnp.where((iot < emit[:, None]) & active[:, None], greedy, -1)
+    return emit, out
+
+
+def accept_sampled(
+    rng: jax.Array,
+    logits: jnp.ndarray,
+    drafts: jnp.ndarray,
+    quota: jnp.ndarray,
+    active: jnp.ndarray,
+    eos_id: jnp.ndarray,
+    temperature: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Distribution-exact acceptance for pure-temperature lanes (see module
+    docstring for the delta-draft derivation), with greedy lanes
+    (temperature <= 0) handled by the argmax rule in the same call so one
+    program serves a mixed batch.
+
+    Args:
+      rng: PRNG key (two subkeys consumed per call).
+      logits: [B, K+1, V] float verify logits; position ``i`` is the
+        distribution for the token after fed position ``i``.
+      drafts: [B, K] int32 proposed tokens fed at verify positions 1..K.
+      quota / active / eos_id: as in ``accept_greedy``.
+      temperature: [B] float; <= 0 selects the greedy rule for that lane.
+
+    Returns:
+      (emit [B] int32, out [B, K+1] int32 emitted tokens, -1 padding).
+    """
+    B, K1, V = logits.shape
+    K = K1 - 1
+    iot = jnp.arange(K1, dtype=jnp.int32)[None, :]
+    logits = logits.astype(jnp.float32)
+    greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # [B, K+1]
+    is_greedy = temperature <= 0.0                               # [B]
+
+    temp = jnp.maximum(temperature, 1e-6)[:, None, None]
+    p = jax.nn.softmax(logits / temp, axis=-1)                   # [B, K+1, V]
+
+    # Accept draft_i with probability p_i(draft_i) (delta-draft rule);
+    # greedy lanes accept on argmax match.
+    p_draft = jnp.take_along_axis(
+        p[:, :K, :], drafts[..., None], axis=-1)[..., 0]         # [B, K]
+    rng_u, rng_c = jax.random.split(rng)
+    u = jax.random.uniform(rng_u, (B, K))
+    acc = jnp.where(is_greedy[:, None],
+                    greedy[:, :K] == drafts,
+                    u < p_draft)
+    n_acc = jnp.sum(jnp.cumprod(acc.astype(jnp.int32), axis=1), axis=1)
+
+    # Boundary token at index n_acc: the model's correction (rejection:
+    # resample from p with the rejected draft zeroed — the (p-q)+ residual)
+    # or the bonus sample (n_acc == K: straight from p).  Greedy lanes take
+    # the argmax.
+    bnd = jnp.clip(n_acc, 0, K)[:, None]
+    p_bnd = jnp.take_along_axis(p, bnd[..., None], axis=1)[:, 0, :]  # [B, V]
+    draft_bnd = jnp.take_along_axis(
+        drafts, jnp.clip(bnd, 0, K - 1), axis=1)[:, 0]           # [B]
+    rejected = n_acc < K
+    zero_mask = (jnp.arange(V, dtype=jnp.int32)[None, :]
+                 == draft_bnd[:, None]) & rejected[:, None]
+    p_res = jnp.where(zero_mask, 0.0, p_bnd)
+    corr = jax.random.categorical(
+        rng_c, jnp.where(p_res > 0, jnp.log(p_res), -jnp.inf), axis=-1
+    ).astype(jnp.int32)
+    greedy_bnd = jnp.take_along_axis(greedy, bnd, axis=1)[:, 0]
+    boundary_tok = jnp.where(is_greedy, greedy_bnd, corr)
+
+    # Emitted row: accepted drafts then the boundary token.
+    base = jnp.concatenate(
+        [drafts, jnp.zeros((B, 1), jnp.int32)], axis=1)          # [B, K+1]
+    toks = jnp.where(iot < n_acc[:, None], base,
+                     jnp.where(iot == n_acc[:, None],
+                               boundary_tok[:, None], 0))
+
+    emit = jnp.minimum(n_acc + 1, quota)
+    is_eos = (toks == eos_id) & (toks >= 0) & (iot < emit[:, None])
+    any_eos = jnp.any(is_eos, axis=1)
+    first_eos = jnp.argmax(is_eos, axis=1).astype(jnp.int32)
+    emit = jnp.where(any_eos, first_eos + 1, emit)
+    emit = jnp.where(active, emit, 0)
+
+    out = jnp.where((iot < emit[:, None]) & active[:, None], toks, -1)
     return emit, out

@@ -22,7 +22,11 @@ from k8s_llm_monitor_tpu.serving.engine import (
     InferenceEngine,
     SamplingParams,
 )
-from k8s_llm_monitor_tpu.serving.spec import accept_greedy, propose_drafts
+from k8s_llm_monitor_tpu.serving.spec import (
+    accept_greedy,
+    accept_sampled,
+    propose_drafts,
+)
 
 CFG = ModelConfig(name="t", vocab_size=300, hidden_size=32,
                   intermediate_size=64, num_layers=2, num_heads=4,
@@ -139,6 +143,80 @@ def test_accept_neg_eos_never_matches_padding():
     assert out[0] == [10, -1, -1, -1]
 
 
+def test_accept_sampled_marginal_distribution():
+    """The delta-draft rule must leave the first emitted token distributed
+    exactly as the target softmax, whatever the draft is: accept draft x
+    w.p. p(x), else resample from p with x zeroed/renormalized.  Checked by
+    Monte Carlo over keys against the analytic marginal."""
+    V = 6
+    logits_row = np.array([2.0, 0.5, 1.0, -1.0, 0.0, 1.5], np.float32)
+    temp = 0.7
+    p = np.exp(logits_row / temp) / np.exp(logits_row / temp).sum()
+    draft0 = 2                                    # fed draft at position 0
+    logits = jnp.asarray(np.tile(logits_row, (1, 3, 1)))   # [B=1, K+1=3, V]
+    drafts = jnp.asarray([[draft0, 1]], jnp.int32)
+    N = 4000
+    keys = jax.random.split(jax.random.PRNGKey(0), N)
+    _, outs = jax.vmap(lambda k: accept_sampled(
+        k, logits, drafts,
+        jnp.asarray([64], jnp.int32), jnp.asarray([True]),
+        jnp.asarray(-1, jnp.int32), jnp.asarray([temp], jnp.float32)))(keys)
+    first = np.asarray(outs)[:, 0, 0]             # [N] first emitted token
+    freq = np.bincount(first, minlength=V) / N
+    np.testing.assert_allclose(freq, p, atol=4.0 / np.sqrt(N),
+                               err_msg=f"marginal {freq} != target {p}")
+
+
+def test_accept_sampled_greedy_lanes_use_argmax():
+    """temperature <= 0 lanes in a sampled-accept call must follow the
+    argmax rule exactly (mixed batches share one program)."""
+    V = 5
+    logits = np.zeros((2, 3, V), np.float32)
+    logits[:, 0, 3] = 9.0                        # argmax after fed token = 3
+    logits[:, 1, 4] = 9.0                        # after draft0 = 4
+    logits[:, 2, 1] = 9.0
+    drafts = jnp.asarray([[3, 4], [0, 0]], jnp.int32)  # lane0 matches argmax
+    emit, out = accept_sampled(
+        jax.random.PRNGKey(0), jnp.asarray(logits), drafts,
+        jnp.asarray([64, 64], jnp.int32), jnp.asarray([True, True]),
+        jnp.asarray(-1, jnp.int32), jnp.asarray([0.0, 0.0], jnp.float32))
+    assert np.asarray(emit).tolist() == [3, 1]
+    assert np.asarray(out)[0].tolist() == [3, 4, 1]
+    assert np.asarray(out)[1].tolist() == [3, -1, -1]
+
+
+def test_spec_sampled_engine_completes(params):
+    """The diagnosis sampling config (temperature 0.1, no top-k/p) must
+    engage sampled speculation and complete with valid tokens.  (Exact
+    distribution preservation is pinned by the Monte-Carlo unit test;
+    near-tied logits mean even tiny temperatures may legitimately diverge
+    from the argmax chain, so no greedy bit-compare here.)"""
+    eng = _spec_engine(params, spec_k=4, rounds=4)
+    rng = np.random.default_rng(19)
+    prompts = [list(rng.integers(3, 300, size=6)) for _ in range(3)]
+    results = eng.generate(
+        prompts, SamplingParams(max_tokens=24, temperature=0.1))
+    assert eng.spec_verify_steps > 0, "sampled speculation never engaged"
+    for r in results:
+        assert len(r.token_ids) == 24
+        assert all(0 <= t < CFG.vocab_size for t in r.token_ids), \
+            "sampled speculation emitted an out-of-vocab token"
+
+
+def test_spec_topp_lane_falls_back(params):
+    """A top-p lane is not spec-eligible (the filtered distribution breaks
+    the delta-draft rule); the dispatch must use the fused path instead."""
+    eng = _spec_engine(params, spec_k=4, rounds=4)
+    rng = np.random.default_rng(21)
+    eng.submit(GenerationRequest(
+        "p0", list(rng.integers(3, 300, size=6)),
+        SamplingParams(max_tokens=12, temperature=0.8, top_p=0.9)))
+    while eng.has_work:
+        eng.step()
+    assert len(eng.poll("p0").token_ids) == 12
+    assert eng.spec_verify_steps == 0
+
+
 # ---------------------------------------------------------------------------
 # verify_step vs sequential decode
 # ---------------------------------------------------------------------------
@@ -249,9 +327,10 @@ def test_spec_eos_termination(params):
     assert hit_eos >= 1
 
 
-def test_spec_mixed_sampling_falls_back(params):
-    """A sampled request in the batch must not break anything: the dispatch
-    falls back to the fused scan path and everyone still completes."""
+def test_spec_mixed_greedy_and_sampled_lanes(params):
+    """Greedy and pure-temperature lanes share one sampled-accept spec
+    program; nucleus (top_p) lanes force the fused fallback.  Both mixes
+    must complete with full budgets."""
     eng = _spec_engine(params)
     rng = np.random.default_rng(5)
     for j in range(4):
@@ -264,6 +343,19 @@ def test_spec_mixed_sampling_falls_back(params):
     for j in range(4):
         res = eng.poll(f"r{j}")
         assert res is not None and len(res.token_ids) == 10
+    assert eng.spec_verify_steps > 0   # pure-temp mix is spec-eligible
+    # Now add a nucleus lane: batch is no longer eligible, fused path runs.
+    before = eng.spec_verify_steps
+    for j in range(2):
+        eng.submit(GenerationRequest(
+            f"n{j}", list(rng.integers(3, 300, size=6)),
+            SamplingParams(max_tokens=10, temperature=0.8,
+                           top_p=0.9 if j == 0 else 1.0)))
+    while eng.has_work:
+        eng.step()
+    for j in range(2):
+        assert len(eng.poll(f"n{j}").token_ids) == 10
+    assert eng.spec_verify_steps == before
 
 
 def test_spec_inflight_then_sampled_admission(params):
@@ -285,9 +377,11 @@ def test_spec_inflight_then_sampled_admission(params):
             break
     assert any(c.kind == "spec" for c in eng._inflight), \
         "test setup: no spec call went in flight"
+    # top_p makes the lane spec-INeligible, flipping dispatch to the fused
+    # path while the spec call is still unreconciled.
     eng.submit(GenerationRequest(
         "s0", list(rng.integers(3, 300, size=5)),
-        SamplingParams(max_tokens=8, temperature=0.9)))
+        SamplingParams(max_tokens=8, temperature=0.9, top_p=0.9)))
     while eng.has_work:
         eng.step()
     for j, p in enumerate(gp):
