@@ -90,6 +90,34 @@ LLAMA3_70B = ModelConfig(
     max_seq_len=8192,
 )
 
+# Mistral-7B (v0.3+: no sliding window, full GQA) — same skeleton as
+# Llama-3 with 32k vocab and theta 1e6; loads from HF safetensors via the
+# same key map (utils/checkpoint.py).
+MISTRAL_7B = ModelConfig(
+    name="mistral-7b",
+    vocab_size=32_768,
+    hidden_size=4096,
+    intermediate_size=14_336,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    rope_theta=1_000_000.0,
+    max_seq_len=32_768,
+)
+
+QWEN2_7B = ModelConfig(
+    name="qwen2-7b",
+    vocab_size=152_064,
+    hidden_size=3584,
+    intermediate_size=18_944,
+    num_layers=28,
+    num_heads=28,
+    num_kv_heads=4,
+    rope_theta=1_000_000.0,
+    max_seq_len=32_768,
+    qkv_bias=True,
+)
+
 QWEN2_72B = ModelConfig(
     name="qwen2-72b",
     vocab_size=152_064,
@@ -120,7 +148,8 @@ LLAMA_1B = ModelConfig(
 
 PRESETS = {
     c.name: c
-    for c in [TINY, TINY_QWEN, LLAMA3_8B, LLAMA3_70B, QWEN2_72B, LLAMA_1B]
+    for c in [TINY, TINY_QWEN, LLAMA3_8B, LLAMA3_70B, MISTRAL_7B,
+              QWEN2_7B, QWEN2_72B, LLAMA_1B]
 }
 
 

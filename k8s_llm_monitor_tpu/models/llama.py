@@ -323,6 +323,7 @@ def _prefill_impl(
     block_tables: jnp.ndarray,
     attend_to_pages: bool,
     return_all_logits: bool = False,
+    paged_attn_fn=None,
 ) -> tuple[jnp.ndarray, KVPages]:
     """Shared prefill layer loop.
 
@@ -349,7 +350,13 @@ def _prefill_impl(
         pv = _scatter_pages(pages.v[li], v, block_tables, positions, valid)
         new_k.append(pk)
         new_v.append(pv)
-        if attend_to_pages:
+        if attend_to_pages and paged_attn_fn is not None:
+            # Page-streaming path (Pallas verify kernel): queries are
+            # contiguous at positions[:, 0] + i, which both verify_step
+            # and prefill_chunk guarantee.
+            attn = paged_attn_fn(q, pk, pv, block_tables,
+                                 positions[:, 0], lengths)
+        elif attend_to_pages:
             # Gathered view is [B, T, KVH*D]; unfuse for attention (the
             # reshape touches the small gathered activation, never the
             # resident page arrays).
@@ -357,9 +364,11 @@ def _prefill_impl(
                 B, -1, cfg.num_kv_heads, cfg.head_dim_)
             vv = gather_pages(pv, block_tables).reshape(
                 B, -1, cfg.num_kv_heads, cfg.head_dim_)
+            attn = causal_attention(q, kk, vv, q_positions=positions,
+                                    kv_len=kv_len)
         else:
-            kk, vv = k, v
-        attn = causal_attention(q, kk, vv, q_positions=positions, kv_len=kv_len)
+            attn = causal_attention(q, k, v, q_positions=positions,
+                                    kv_len=kv_len)
         x = x + _linear(layer["o"], attn.reshape(B, S, -1), cfg.act_quant)
         h = rms_norm(x, layer["post_norm"], cfg.rms_norm_eps)
         x = x + _mlp(layer, cfg, h)
@@ -442,6 +451,8 @@ def verify_step(
     lengths: jnp.ndarray,
     pages: KVPages,
     block_tables: jnp.ndarray,
+    *,
+    attn_impl=None,
 ) -> tuple[jnp.ndarray, KVPages]:
     """Speculative-decode verify pass: score ``S`` candidate tokens at once.
 
@@ -459,6 +470,9 @@ def verify_step(
     In greedy acceptance (token must equal the argmax) any draft source is
     correctness-neutral: the accepted prefix is exactly what step-by-step
     greedy decode would have produced.
+
+    ``attn_impl``: optional paged multi-query attention (the Pallas verify
+    kernel, ops/attention.py:select_verify_impl); None = XLA gather.
     """
     B, S = tokens.shape
     offs = jnp.arange(S, dtype=jnp.int32)
@@ -466,7 +480,8 @@ def verify_step(
     valid = offs[None, :] < lengths[:, None]
     return _prefill_impl(params, cfg, tokens, positions, valid, lengths,
                          start + lengths, pages, block_tables,
-                         attend_to_pages=True, return_all_logits=True)
+                         attend_to_pages=True, return_all_logits=True,
+                         paged_attn_fn=attn_impl)
 
 
 # ---------------------------------------------------------------------------

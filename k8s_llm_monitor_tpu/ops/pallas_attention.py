@@ -52,24 +52,34 @@ NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 _WINDOW = 8
 
 
-def _decode_kernel(
+def _paged_attn_kernel(
+    QS,                    # static: query tokens per sequence (1 = decode)
+    H,                     # static: query heads per token
     # scalar prefetch
     tables_ref,            # [B, NB] int32 block ids
-    lens_ref,              # [B] int32 valid kv length per sequence
+    starts_ref,            # [B] int32 cached tokens before this chunk
+    qlens_ref,             # [B] int32 query tokens this call (<= QS)
     # inputs
-    q_ref,                 # [TB, H, KVH*D] block-diagonal queries (VMEM)
+    q_ref,                 # [TB, QS*H, F] block-diagonal queries (VMEM)
     k_hbm,                 # [num_blocks, bs, KVH*D] (ANY/HBM, whole array)
     v_hbm,                 # same
     # out
-    o_ref,                 # [TB, H, KVH*D]
+    o_ref,                 # [TB, QS*H, F]
 ):
+    """One program handles TB sequences; each sequence streams its pages
+    ONCE for all QS query tokens.  Query token i (rows i*H..i*H+H-1)
+    attends causally through absolute position ``starts[b] + i`` — the
+    verify/chunk semantics; QS=1 with starts = lengths-1 is exactly the
+    decode case.  K/V for the chunk's own tokens must already be written
+    into the pages (models/llama.py scatters before attention)."""
     TB = q_ref.shape[0]                                    # seqs per program
     b0 = pl.program_id(0) * TB
     bs = k_hbm.shape[1]
-    H = q_ref.shape[1]
     F = q_ref.shape[2]                                     # KVH * D
     NB = tables_ref.shape[1]
     W = min(_WINDOW, NB)
+    # Row r of the [QS*H, F] tile belongs to query token r // H.
+    row_q = jax.lax.broadcasted_iota(jnp.int32, (QS * H, 1), 0) // H
 
     def scoped(k_buf, v_buf, sem):
         # k_buf/v_buf: [2, W*bs, F] double-buffered page slabs, reused
@@ -107,14 +117,20 @@ def _decode_kernel(
         # cross-sequence prefetch non-trivial; measured immaterial on v5e).
         for t in range(TB):
             b = b0 + t
-            length = lens_ref[b]
+            start = starts_ref[b]
+            # Stream every page the chunk's last token can see.  Inactive
+            # lanes (qlen 0) stream ONE masked window, not their whole dead
+            # context: a lane that finished in round 1 of a multi-round
+            # spec call would otherwise re-stream ctx pages per layer per
+            # remaining round just to produce discarded rows.
+            length = jnp.where(qlens_ref[b] > 0, start + qlens_ref[b], 1)
             n_blocks = (length + bs - 1) // bs             # >= 1
             n_windows = (n_blocks + W - 1) // W
             start_window(0, b, 0)
-            q = q_ref[t].astype(jnp.float32)               # [H, F] block-diag
+            q = q_ref[t].astype(jnp.float32)           # [QS*H, F] block-diag
 
-            def body(w, carry, b=b, length=length, n_windows=n_windows):
-                m, l, acc = carry              # [H, 1], [H, 1], [H, F] (f32)
+            def body(w, carry, b=b, start=start, n_windows=n_windows):
+                m, l, acc = carry          # [QS*H, 1], [QS*H, 1], [QS*H, F]
                 slot = jax.lax.rem(w, 2)
 
                 @pl.when(w + 1 < n_windows)
@@ -124,7 +140,9 @@ def _decode_kernel(
                 wait_window(slot, b, w)
                 pos = (w * (W * bs)
                        + jax.lax.broadcasted_iota(jnp.int32, (1, W * bs), 1))
-                valid = pos < length                        # [1, W*bs]
+                # Per-row causal bound: query token i sits at absolute
+                # position start + i, attending through itself.
+                valid = pos < start + 1 + row_q             # [QS*H, W*bs]
                 kblk = k_buf[slot].astype(jnp.float32)      # [W*bs, F]
                 vblk = v_buf[slot].astype(jnp.float32)
 
@@ -134,23 +152,23 @@ def _decode_kernel(
                 s = jax.lax.dot_general(
                     q, kblk, (((1,), (1,)), ((), ())),
                     preferred_element_type=jnp.float32,
-                )                                           # [H, W*bs]
+                )                                           # [QS*H, W*bs]
                 s = jnp.where(valid, s, NEG_INF)
 
                 m_cur = jnp.max(s, axis=-1, keepdims=True)
                 m_new = jnp.maximum(m, m_cur)
                 alpha = jnp.exp(m - m_new)
-                p = jnp.exp(s - m_new)                      # [H, W*bs]
+                p = jnp.exp(s - m_new)                      # [QS*H, W*bs]
                 l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
                 pv = jax.lax.dot_general(
                     p, vblk, (((1,), (0,)), ((), ())),
                     preferred_element_type=jnp.float32,
-                )                                           # [H, F]
+                )                                           # [QS*H, F]
                 return m_new, l_new, alpha * acc + pv
 
-            m0 = jnp.full((H, 1), NEG_INF, jnp.float32)
-            l0 = jnp.zeros((H, 1), jnp.float32)
-            acc0 = jnp.zeros((H, F), jnp.float32)
+            m0 = jnp.full((QS * H, 1), NEG_INF, jnp.float32)
+            l0 = jnp.zeros((QS * H, 1), jnp.float32)
+            acc0 = jnp.zeros((QS * H, F), jnp.float32)
             _, l, acc = jax.lax.fori_loop(0, n_windows, body, (m0, l0, acc0))
             # acc rows carry the head's output in its kv-group slice (plus
             # group-mates' contributions in other slices, sliced away by
@@ -163,6 +181,69 @@ def _decode_kernel(
         v_buf=pltpu.VMEM((2, W * bs, F), v_hbm.dtype),
         sem=pltpu.SemaphoreType.DMA((2, W, 2)),
     )
+
+
+def _run_paged_attn(q, k_pages, v_pages, block_table, starts, qlens,
+                    interpret):
+    """Shared wrapper: block-diagonalize queries, tile the batch, run the
+    unified kernel, extract each head's kv-group slice.
+
+    q: [B, QS, H, D] — QS query tokens per sequence at absolute positions
+    ``starts[b] + i``; returns [B, QS, H, D].
+    """
+    B, QS, H, D = q.shape
+    nblk, bs, F = k_pages.shape
+    assert F % D == 0 and D <= 128, (F, D)
+    KVH = F // D
+    q_per_kv = H // KVH
+
+    # Block-diagonal queries (scaled): head h lives in its kv group's
+    # D-slice of the F lane dim, zeros elsewhere — see _paged_attn_kernel.
+    group = jnp.arange(H, dtype=jnp.int32) // q_per_kv            # [H]
+    onehot = jax.nn.one_hot(group, KVH, dtype=q.dtype)            # [H, KVH]
+    q_bd = (q[:, :, :, None, :] * (D ** -0.5)
+            * onehot[None, None, :, :, None]).reshape(B, QS * H, F)
+
+    # Batch-tile: TB sequences per program amortize per-program grid
+    # startup — at B=128 this is 16 programs instead of 128, 8 per
+    # megacore half.  (Measured neutral vs grid=(B,) on v5e at B=128; the
+    # decode-attention cost there is dependency-serialization against the
+    # surrounding matmuls, not program count.)  Keep at least 2 programs
+    # so both megacore halves stay busy at small B, and bound the q/o VMEM
+    # tiles to ~4 MiB for multi-query (verify) calls.
+    budget = 4 * 2**20 // max(QS * H * F * q.dtype.itemsize, 1)
+    TB = next(tb for tb in (8, 4, 2, 1)
+              if B % tb == 0 and (B // tb >= 2 or B == 1)
+              and (tb <= budget or tb == 1))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B // TB,),
+        in_specs=[
+            pl.BlockSpec((TB, QS * H, F), lambda p, tbl, st, ql: (p, 0, 0)),
+            pl.BlockSpec(memory_space=pl.ANY),   # K pages stay in HBM
+            pl.BlockSpec(memory_space=pl.ANY),   # V pages stay in HBM
+        ],
+        out_specs=pl.BlockSpec((TB, QS * H, F),
+                               lambda p, tbl, st, ql: (p, 0, 0)),
+    )
+
+    out_full = pl.pallas_call(
+        functools.partial(_paged_attn_kernel, QS, H),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, QS * H, F), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            # Programs touch disjoint q/o tiles and only read pages: the
+            # tile grid is safely parallel (megacore splits it).
+            dimension_semantics=("parallel",),
+        ),
+        interpret=interpret,
+    )(block_table, starts, qlens, q_bd, k_pages, v_pages)
+
+    # Extract each head's own kv-group slice.
+    out = jnp.take_along_axis(
+        out_full.reshape(B, QS, H, KVH, D),
+        group[None, None, :, None, None], axis=3)[:, :, :, 0, :]
+    return out
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))
@@ -193,51 +274,41 @@ def paged_decode_attention_pallas(
     """
     B, S, H, D = q.shape
     assert S == 1, f"decode kernel expects one query token, got {S}"
-    nblk, bs, F = k_pages.shape
-    assert F % D == 0 and D <= 128, (F, D)
-    KVH = F // D
-    q_per_kv = H // KVH
+    starts = jnp.maximum(lengths - 1, 0).astype(jnp.int32)
+    qlens = jnp.minimum(lengths, 1).astype(jnp.int32)
+    return _run_paged_attn(q, k_pages, v_pages, block_table, starts, qlens,
+                           interpret)
 
-    # Block-diagonal queries (scaled): head h lives in its kv group's
-    # D-slice of the F lane dim, zeros elsewhere — see _decode_kernel.
-    group = jnp.arange(H, dtype=jnp.int32) // q_per_kv            # [H]
-    onehot = jax.nn.one_hot(group, KVH, dtype=q.dtype)            # [H, KVH]
-    q_bd = (q[:, 0, :, None, :] * (D ** -0.5)
-            * onehot[None, :, :, None]).reshape(B, H, F)
 
-    # Batch-tile: TB sequences per program amortize per-program grid
-    # startup — at B=128 this is 16 programs instead of 128, 8 per
-    # megacore half.  (Measured neutral vs grid=(B,) on v5e at B=128; the
-    # decode-attention cost there is dependency-serialization against the
-    # surrounding matmuls, not program count.)  Keep at least 2 programs
-    # so both megacore halves stay busy at small B.
-    TB = next(tb for tb in (8, 4, 2, 1)
-              if B % tb == 0 and (B // tb >= 2 or B == 1))
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
-        grid=(B // TB,),
-        in_specs=[
-            pl.BlockSpec((TB, H, F), lambda p, tbl, lens: (p, 0, 0)),
-            pl.BlockSpec(memory_space=pl.ANY),   # K pages stay in HBM
-            pl.BlockSpec(memory_space=pl.ANY),   # V pages stay in HBM
-        ],
-        out_specs=pl.BlockSpec((TB, H, F), lambda p, tbl, lens: (p, 0, 0)),
-    )
+@functools.partial(jax.jit, static_argnames=("interpret",))
+def paged_verify_attention_pallas(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    block_table: jnp.ndarray,
+    start: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Multi-query paged attention for speculative verify / small chunks.
 
-    out_full = pl.pallas_call(
-        _decode_kernel,
-        grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((B, H, F), q.dtype),
-        compiler_params=pltpu.CompilerParams(
-            # Programs touch disjoint q/o tiles and only read pages: the
-            # tile grid is safely parallel (megacore splits it).
-            dimension_semantics=("parallel",),
-        ),
-        interpret=interpret,
-    )(block_table, lengths, q_bd, k_pages, v_pages)
+    Query token ``i`` of sequence ``b`` sits at absolute position
+    ``start[b] + i`` and attends causally through itself; the chunk's K/V
+    must already be scattered into the pages.  Streams each sequence's
+    pages ONCE for all S queries — vs the XLA gather fallback's
+    O(B * max_blocks * bs) traffic, and vs S separate decode-kernel calls'
+    S-fold re-streaming.
 
-    # Extract each head's own kv-group slice.
-    out = jnp.take_along_axis(
-        out_full.reshape(B, H, KVH, D),
-        group[None, :, None, None], axis=2)[:, :, 0, :]
-    return out[:, None]
+    Args:
+      q: [B, S, H, D] (S small — the spec draft length + 1).
+      start: [B] int32 tokens already cached before this chunk.
+      lengths: [B] int32 valid query tokens (0 = inactive lane; its rows
+        compute against the null block and are discarded by the caller).
+
+    Returns:
+      [B, S, H, D] in q.dtype.
+    """
+    return _run_paged_attn(q, k_pages, v_pages, block_table,
+                           start.astype(jnp.int32),
+                           lengths.astype(jnp.int32), interpret)

@@ -273,6 +273,16 @@ class InferenceEngine:
             # partitions automatically.
             attn_impl = select_attn_impl(cfg=cfg, mesh=mesh)
         self._attn_impl = attn_impl
+        # Multi-query attention for the speculative verify pass (Pallas
+        # kernel on compatible single-chip TPU; XLA gather otherwise).
+        if self.ecfg.spec_k > 0:
+            from k8s_llm_monitor_tpu.ops.attention import select_verify_impl
+
+            self._verify_impl = select_verify_impl(
+                cfg=cfg, mesh=mesh,
+                max_table_tokens=ec.max_blocks_per_seq * ec.block_size)
+        else:
+            self._verify_impl = None
 
         def _prefill_sample_fn(params, tokens, lengths, pages, tables,
                                temp, topk, topp, rng):
@@ -923,7 +933,8 @@ class InferenceEngine:
                 toks_in = jnp.concatenate([tok[:, None], drafts], axis=1)
                 lengths = jnp.where(act, k + 1, 0).astype(jnp.int32)
                 logits, pages = llama.verify_step(
-                    params, cfg, toks_in, ctx, lengths, pages, tables)
+                    params, cfg, toks_in, ctx, lengths, pages, tables,
+                    attn_impl=self._verify_impl)
                 if sampled:
                     rng, sub = jax.random.split(rng)
                     emit, out = accept_sampled(

@@ -105,3 +105,122 @@ def test_bf16_parity():
     np.testing.assert_allclose(
         np.asarray(got, np.float32), np.asarray(want, np.float32),
         rtol=2e-2, atol=2e-2)
+
+
+# ---------------------------------------------------------------------------
+# Multi-query verify kernel
+# ---------------------------------------------------------------------------
+
+
+def _random_verify_case(rng, B, S, H, KVH, D, bs, max_blocks):
+    """Random verify case: per-lane cached prefix of ``start`` tokens plus
+    an S-token chunk already scattered into the pages."""
+    num_blocks = B * max_blocks + 2
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    k_pages = jnp.asarray(
+        rng.standard_normal((num_blocks, bs, KVH * D)), jnp.float32)
+    v_pages = jnp.asarray(
+        rng.standard_normal((num_blocks, bs, KVH * D)), jnp.float32)
+    start = rng.integers(0, max_blocks * bs - S, size=(B,)).astype(np.int32)
+    lengths = np.full((B,), S, np.int32)
+    table = np.zeros((B, max_blocks), np.int32)
+    next_free = 1
+    for b in range(B):
+        used = -(-int(start[b] + S) // bs)
+        for j in range(used):
+            table[b, j] = next_free
+            next_free += 1
+    assert next_free <= num_blocks
+    return (q, k_pages, v_pages, jnp.asarray(table),
+            jnp.asarray(start), jnp.asarray(lengths))
+
+
+@pytest.mark.parametrize("B,S,H,KVH,D,bs,max_blocks", [
+    (4, 5, 8, 8, 64, 16, 4),    # MHA, spec_k=4 shape
+    (4, 5, 8, 2, 64, 16, 4),    # GQA 4:1
+    (2, 4, 16, 4, 128, 8, 6),   # GQA, D=128
+    (1, 2, 4, 1, 32, 4, 3),     # MQA-ish, tiny
+    (4, 1, 8, 2, 64, 16, 4),    # S=1 degenerates to decode semantics
+])
+def test_verify_kernel_matches_xla_reference(B, S, H, KVH, D, bs, max_blocks):
+    from k8s_llm_monitor_tpu.ops.attention import paged_verify_attention
+    from k8s_llm_monitor_tpu.ops.pallas_attention import (
+        paged_verify_attention_pallas,
+    )
+
+    rng = np.random.default_rng(B * 7919 + S * 131 + H + KVH + D)
+    q, kp, vp, table, start, lens = _random_verify_case(
+        rng, B, S, H, KVH, D, bs, max_blocks)
+    want = paged_verify_attention(q, kp, vp, table, start, lens)
+    got = paged_verify_attention_pallas(q, kp, vp, table, start, lens,
+                                        interpret=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_verify_kernel_inactive_and_start_zero():
+    """Inactive lanes (length 0, null table) and start=0 lanes (first
+    tokens of a fresh sequence) must be NaN-free and match the reference
+    on active rows."""
+    from k8s_llm_monitor_tpu.ops.attention import paged_verify_attention
+    from k8s_llm_monitor_tpu.ops.pallas_attention import (
+        paged_verify_attention_pallas,
+    )
+
+    rng = np.random.default_rng(3)
+    B, S, H, KVH, D, bs, max_blocks = 3, 4, 8, 4, 64, 8, 4
+    q = jnp.asarray(rng.standard_normal((B, S, H, D)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((16, bs, KVH * D)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((16, bs, KVH * D)), jnp.float32)
+    table = np.zeros((B, max_blocks), np.int32)
+    table[0, :1] = [1]            # start=0 lane: chunk only
+    table[2, :2] = [2, 3]         # start>0 lane
+    start = jnp.asarray([0, 0, 9], jnp.int32)
+    lens = jnp.asarray([S, 0, S], jnp.int32)   # lane 1 inactive
+    want = paged_verify_attention(q, kp, vp, jnp.asarray(table), start, lens)
+    got = paged_verify_attention_pallas(q, kp, vp, jnp.asarray(table),
+                                        start, lens, interpret=True)
+    assert not np.any(np.isnan(np.asarray(got)))
+    for b in (0, 2):
+        np.testing.assert_allclose(np.asarray(got)[b], np.asarray(want)[b],
+                                   rtol=2e-5, atol=2e-5)
+
+
+def test_verify_vs_sequential_decode_kernel():
+    """S staggered decode-kernel calls must equal one verify call: query i
+    with context start+i+1."""
+    from k8s_llm_monitor_tpu.ops.pallas_attention import (
+        paged_verify_attention_pallas,
+    )
+
+    rng = np.random.default_rng(11)
+    B, S, H, KVH, D, bs, max_blocks = 2, 3, 8, 2, 64, 8, 6
+    q, kp, vp, table, start, lens = _random_verify_case(
+        rng, B, S, H, KVH, D, bs, max_blocks)
+    ver = paged_verify_attention_pallas(q, kp, vp, table, start, lens,
+                                        interpret=True)
+    for i in range(S):
+        dec = paged_decode_attention_pallas(
+            q[:, i:i + 1], kp, vp, table, start + i + 1, interpret=True)
+        np.testing.assert_allclose(np.asarray(ver[:, i:i + 1]),
+                                   np.asarray(dec), rtol=2e-5, atol=2e-5)
+
+
+def test_select_verify_impl_gate():
+    from k8s_llm_monitor_tpu.ops.attention import (
+        VERIFY_KERNEL_MIN_TABLE_TOKENS,
+        paged_verify_attention,
+        select_verify_impl,
+    )
+
+    # CPU always gets the gather reference.
+    assert select_verify_impl("cpu") is paged_verify_attention
+    # Short tables stay on the gather even on TPU.
+    assert select_verify_impl(
+        "tpu", max_table_tokens=VERIFY_KERNEL_MIN_TABLE_TOKENS - 1,
+    ) is paged_verify_attention
+    # Long tables select the kernel (import-guarded).
+    impl = select_verify_impl(
+        "tpu", max_table_tokens=VERIFY_KERNEL_MIN_TABLE_TOKENS)
+    assert impl.__name__ in ("paged_verify_attention_pallas",
+                             "paged_verify_attention")

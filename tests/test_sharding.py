@@ -70,6 +70,32 @@ def test_tp_engine_generation_matches_unsharded(cpu_mesh_devices):
         assert a.token_ids == b.token_ids
 
 
+def test_all_presets_are_coherent_and_tp8_shardable():
+    """Every serving preset must have integral GQA/head geometry and a
+    parameter pytree whose model-sharded axes divide a TP-8 mesh (or fall
+    back to replication) — checked via eval_shape, no weights built."""
+    from k8s_llm_monitor_tpu.models.config import PRESETS
+
+    for name, cfg in PRESETS.items():
+        assert cfg.hidden_size % cfg.num_heads == 0 or cfg.head_dim, name
+        assert cfg.num_heads % cfg.num_kv_heads == 0, name
+        assert cfg.head_dim_ * cfg.num_heads <= 2 * cfg.hidden_size, name
+        shapes = jax.eval_shape(
+            lambda rng, c=cfg: llama.init_params(rng, c),
+            jax.random.PRNGKey(0))
+        specs = param_partition_specs(shapes)
+
+        def check(path, leaf, spec):
+            for dim, axis in enumerate(spec):
+                if axis == "model":
+                    assert leaf.shape[dim] % 8 == 0, (
+                        f"{name}: {path} {leaf.shape} axis {dim} "
+                        f"not divisible by TP-8")
+
+        jax.tree_util.tree_map_with_path(
+            lambda p, l, s: check(p, l, s), shapes, specs)
+
+
 def test_70b_class_specs_divide_on_tp8_and_tp16():
     """BASELINE config #5 (70B-class GSPMD TP): every parameter's sharded
     axis must divide evenly on TP-8 and TP-16 meshes, and the KV pages fall
