@@ -356,18 +356,18 @@ def _prefill_impl(
             # and prefill_chunk guarantee.
             attn = paged_attn_fn(q, pk, pv, block_tables,
                                  positions[:, 0], lengths)
-        elif attend_to_pages:
-            # Gathered view is [B, T, KVH*D]; unfuse for attention (the
-            # reshape touches the small gathered activation, never the
-            # resident page arrays).
-            kk = gather_pages(pk, block_tables).reshape(
-                B, -1, cfg.num_kv_heads, cfg.head_dim_)
-            vv = gather_pages(pv, block_tables).reshape(
-                B, -1, cfg.num_kv_heads, cfg.head_dim_)
-            attn = causal_attention(q, kk, vv, q_positions=positions,
-                                    kv_len=kv_len)
         else:
-            attn = causal_attention(q, k, v, q_positions=positions,
+            if attend_to_pages:
+                # Gathered view is [B, T, KVH*D]; unfuse for attention (the
+                # reshape touches the small gathered activation, never the
+                # resident page arrays).
+                kk = gather_pages(pk, block_tables).reshape(
+                    B, -1, cfg.num_kv_heads, cfg.head_dim_)
+                vv = gather_pages(pv, block_tables).reshape(
+                    B, -1, cfg.num_kv_heads, cfg.head_dim_)
+            else:
+                kk, vv = k, v
+            attn = causal_attention(q, kk, vv, q_positions=positions,
                                     kv_len=kv_len)
         x = x + _linear(layer["o"], attn.reshape(B, S, -1), cfg.act_quant)
         h = rms_norm(x, layer["post_norm"], cfg.rms_norm_eps)

@@ -27,15 +27,20 @@ def params():
     return llama.init_params(jax.random.PRNGKey(0), CFG)
 
 
-def test_stress_mixed_workload_under_pressure(params):
+@pytest.mark.parametrize("spec_k", [0, 4])
+def test_stress_mixed_workload_under_pressure(params, spec_k):
     """40 requests with random prompts/budgets through a pool small enough
-    to force preemptions, with EOS active and cancels injected mid-flight."""
+    to force preemptions, with EOS active and cancels injected mid-flight.
+    The spec_k=4 variant mixes greedy, pure-temperature, and top-p lanes so
+    dispatches alternate between greedy-spec, sampled-spec, and the fused
+    fallback while preemption and cancels fire."""
     eng = InferenceEngine(
         CFG, params,
         EngineConfig(max_slots=4, num_blocks=40, block_size=4,
                      max_blocks_per_seq=24, prefill_buckets=(16, 32),
                      max_prefills_per_step=4, max_admission_rounds=2,
-                     decode_steps_per_iter=4, max_inflight=2),
+                     decode_steps_per_iter=4, max_inflight=2,
+                     spec_k=spec_k, spec_rounds_per_iter=2),
         eos_id=7,  # a plausible token: some generations stop early
     )
     rng = np.random.default_rng(0)
@@ -45,10 +50,16 @@ def test_stress_mixed_workload_under_pressure(params):
         L = int(rng.integers(3, 60))          # some prompts need chunking
         mt = int(rng.integers(1, 30))
         budgets[f"s{i}"] = mt
+        if spec_k and i % 3 == 1:
+            sp = SamplingParams(max_tokens=mt, temperature=0.8)
+        elif spec_k and i % 3 == 2:
+            sp = SamplingParams(max_tokens=mt, temperature=0.8, top_p=0.9)
+        else:
+            sp = SamplingParams(max_tokens=mt)
         eng.submit(GenerationRequest(
             request_id=f"s{i}",
             prompt_ids=list(rng.integers(8, 300, size=L)),  # avoid eos id
-            sampling=SamplingParams(max_tokens=mt),
+            sampling=sp,
         ))
 
     cancelled = set()
