@@ -35,6 +35,12 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--remat", action="store_true")
     parser.add_argument("--ring-attention", action="store_true",
                         help="explicit ring attention over the seq axis")
+    parser.add_argument("--pipeline", type=int, default=0,
+                        help="pipeline-parallel stages (GPipe over a "
+                             "data x pipe mesh; parallel/pipeline.py); "
+                             "0/1 = off.  Mutually exclusive with --mesh")
+    parser.add_argument("--microbatches", type=int, default=4,
+                        help="microbatches per step under --pipeline")
     parser.add_argument("--data", default="", help="flat int32 token .npy")
     parser.add_argument("--seed", type=int, default=0,
                         help="data-stream seed (offset by resumed step)")
@@ -78,34 +84,94 @@ def main(argv: list[str] | None = None) -> int:
                  pid, jax.process_count(), jax.local_device_count(),
                  jax.device_count())
     n_dev = len(jax.devices())
-    if args.mesh:
-        d, s, m = (int(x) for x in args.mesh.split(","))
-        mcfg = MeshConfig(data=d, seq=s, model=m)
-    else:
-        mcfg = MeshConfig(data=n_dev)
-    mesh = create_mesh(mcfg, devices=jax.devices()[: mcfg.size])
-    log.info("mesh: data=%d seq=%d model=%d on %d %s device(s)",
-             mcfg.data, mcfg.seq, mcfg.model, mcfg.size,
-             jax.devices()[0].platform)
-
-    tc = TrainConfig(learning_rate=args.lr, remat=args.remat,
-                     ring_attention=args.ring_attention)
-    state = create_train_state(jax.random.PRNGKey(0), cfg, tc)
     step0 = 0
-    if args.resume:
-        # Full train state: params + AdamW moments + step, so resumption
-        # continues the run instead of restarting the optimizer.
-        restored = restore_checkpoint(
-            args.resume,
-            like={"params": state.params, "opt_state": state.opt_state,
-                  "step": 0},
+    if args.pipeline > 1:
+        # GPipe pipeline parallelism: contiguous layer blocks over `pipe`,
+        # data parallel over the rest (parallel/pipeline.py).
+        if args.mesh:
+            log.error("--pipeline and --mesh are mutually exclusive")
+            return 1
+        if args.ring_attention:
+            log.error("--ring-attention is not available under --pipeline "
+                      "(stages run dense attention over whole sequences)")
+            return 1
+        if args.pipeline > n_dev or n_dev % args.pipeline:
+            log.error("%d pipeline stages must evenly split the %d devices",
+                      args.pipeline, n_dev)
+            return 1
+        dp = n_dev // args.pipeline
+        if args.batch % args.microbatches or \
+                (args.batch // args.microbatches) % dp:
+            log.error("--batch (%d) must be a multiple of --microbatches "
+                      "(%d) x data-parallel degree (%d)",
+                      args.batch, args.microbatches, dp)
+            return 1
+        from k8s_llm_monitor_tpu.models import llama
+        from k8s_llm_monitor_tpu.parallel.pipeline import (
+            create_pp_mesh,
+            make_pipeline_train_step,
+            place_pipeline_opt_state,
+            place_pipeline_params,
+            stack_pipeline_params,
         )
-        state.params = restored["params"]
-        state.opt_state = restored["opt_state"]
-        step0 = int(restored["step"])
-        log.info("resumed from %s at step %d", args.resume, step0)
-    state = shard_train_state(state, mesh)
-    step_fn = make_train_step(cfg, tc, mesh=mesh)
+        from k8s_llm_monitor_tpu.training.train import make_optimizer
+
+        mesh = create_pp_mesh(dp, args.pipeline)
+        log.info("mesh: data=%d pipe=%d on %d %s device(s); "
+                 "%d microbatches (bubble overhead %d/%d ticks)",
+                 dp, args.pipeline, n_dev, jax.devices()[0].platform,
+                 args.microbatches, args.pipeline - 1,
+                 args.microbatches + args.pipeline - 1)
+        tc = TrainConfig(learning_rate=args.lr, remat=True)
+        optimizer = make_optimizer(tc)
+        staged = stack_pipeline_params(
+            llama.init_params(jax.random.PRNGKey(0), cfg), args.pipeline)
+        opt_state = optimizer.init(staged)
+        if args.resume:
+            restored = restore_checkpoint(
+                args.resume,
+                like={"params": staged, "opt_state": opt_state, "step": 0})
+            staged = restored["params"]
+            opt_state = restored["opt_state"]
+            step0 = int(restored["step"])
+            log.info("resumed from %s at step %d", args.resume, step0)
+        params = place_pipeline_params(staged, mesh)
+        opt_state = place_pipeline_opt_state(opt_state, args.pipeline, mesh)
+        step_fn = make_pipeline_train_step(mesh, cfg, optimizer,
+                                           args.microbatches)
+        from jax.sharding import PartitionSpec as _P
+        token_spec = _P("data", None)
+    else:
+        if args.mesh:
+            d, s, m = (int(x) for x in args.mesh.split(","))
+            mcfg = MeshConfig(data=d, seq=s, model=m)
+        else:
+            mcfg = MeshConfig(data=n_dev)
+        mesh = create_mesh(mcfg, devices=jax.devices()[: mcfg.size])
+        log.info("mesh: data=%d seq=%d model=%d on %d %s device(s)",
+                 mcfg.data, mcfg.seq, mcfg.model, mcfg.size,
+                 jax.devices()[0].platform)
+
+        tc = TrainConfig(learning_rate=args.lr, remat=args.remat,
+                         ring_attention=args.ring_attention)
+        state = create_train_state(jax.random.PRNGKey(0), cfg, tc)
+        if args.resume:
+            # Full train state: params + AdamW moments + step, so
+            # resumption continues the run instead of restarting the
+            # optimizer.
+            restored = restore_checkpoint(
+                args.resume,
+                like={"params": state.params, "opt_state": state.opt_state,
+                      "step": 0},
+            )
+            state.params = restored["params"]
+            state.opt_state = restored["opt_state"]
+            step0 = int(restored["step"])
+            log.info("resumed from %s at step %d", args.resume, step0)
+        state = shard_train_state(state, mesh)
+        step_fn = make_train_step(cfg, tc, mesh=mesh)
+        token_spec = data_spec()
+        params, opt_state = state.params, state.opt_state
 
     if args.data:
         corpus = np.load(args.data, mmap_mode="r")
@@ -120,7 +186,7 @@ def main(argv: list[str] | None = None) -> int:
 
     from jax.sharding import NamedSharding
 
-    sharding = NamedSharding(mesh, data_spec())
+    sharding = NamedSharding(mesh, token_spec)
     # Seed the data stream with the restored step: a resumed run continues
     # the stream instead of replaying the batch windows already trained on
     # (advisor r3).
@@ -135,7 +201,6 @@ def main(argv: list[str] | None = None) -> int:
             batch = rng.integers(0, cfg.vocab_size, size=(B, S))
         return jax.device_put(batch.astype(np.int32), sharding)
 
-    params, opt_state = state.params, state.opt_state
     t0 = time.monotonic()
     tokens_seen = 0
     last = step0 + args.steps

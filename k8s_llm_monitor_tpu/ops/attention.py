@@ -247,20 +247,20 @@ def make_tp_paged_attention(mesh, cfg, interpret: bool = False):
     """
     import functools
 
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
 
     from k8s_llm_monitor_tpu.ops.pallas_attention import (
         paged_decode_attention_pallas,
     )
+    from k8s_llm_monitor_tpu.parallel.mesh import shard_map_compat
 
     qspec = P(None, None, "model", None)       # query heads over TP
     pspec = P(None, None, "model")             # fused kv lanes over TP
 
     @functools.partial(
-        shard_map, mesh=mesh,
+        shard_map_compat, mesh=mesh,
         in_specs=(qspec, pspec, pspec, P(None, None), P(None)),
-        out_specs=qspec, check_rep=False)
+        out_specs=qspec, check_replication=False)
     def attn(q, k_pages, v_pages, block_table, lengths):
         return paged_decode_attention_pallas(
             q, k_pages, v_pages, block_table, lengths, interpret=interpret)

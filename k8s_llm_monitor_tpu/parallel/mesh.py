@@ -67,6 +67,22 @@ def init_multihost(coordinator: str | None = None,
     return jax.process_index()
 
 
+def shard_map_compat(f, *, mesh, in_specs, out_specs,
+                     check_replication=True):
+    """``jax.shard_map`` across the 0.8 API rename (check_rep -> check_vma)
+    — the single compat point for every shard_map call site in the tree."""
+    try:  # jax >= 0.8
+        from jax import shard_map as _sm
+
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_vma=check_replication)
+    except ImportError:  # pragma: no cover - older jax
+        from jax.experimental.shard_map import shard_map as _sm
+
+        return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                   check_rep=check_replication)
+
+
 @dataclasses.dataclass(frozen=True)
 class MeshConfig:
     data: int = 1

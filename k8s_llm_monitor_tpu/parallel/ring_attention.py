@@ -27,12 +27,9 @@ import jax.numpy as jnp
 
 from k8s_llm_monitor_tpu.ops.attention import NEG_INF, _repeat_kv
 
-try:  # jax >= 0.8
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover - older jax
-    from jax.experimental.shard_map import shard_map as _shard_map
-
 from jax.sharding import Mesh, PartitionSpec as P
+
+from k8s_llm_monitor_tpu.parallel.mesh import shard_map_compat as _shard_map
 
 
 def _block_update(q, k, v, q_pos, kv_pos, kv_len, m, l, acc):
