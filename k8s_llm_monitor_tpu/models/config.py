@@ -48,6 +48,17 @@ class ModelConfig:
     # int8-quantized weights.  Requires kernel_q weights
     # (utils/quantize.py).  Attention, norms, and residuals stay bf16.
     act_quant: bool = False
+    # Mixture-of-experts MLP (Mixtral family): > 0 replaces every layer's
+    # SwiGLU with num_experts expert FFNs behind a top-k router (GShard
+    # capacity dispatch, models/llama.py:_moe_mlp).  Expert weights carry a
+    # leading [num_experts] axis sharded over the mesh's ``model`` axis —
+    # expert parallelism rides the same axis tensor parallelism uses, and
+    # XLA inserts the dispatch/combine all-to-alls from the shardings.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    # Per-expert token capacity = ceil(tokens * top_k * capacity_factor /
+    # num_experts); overflow tokens skip the MLP (residual passes through).
+    capacity_factor: float = 1.25
 
     @property
     def head_dim_(self) -> int:
@@ -65,6 +76,9 @@ class ModelConfig:
 TINY = ModelConfig(name="tiny")
 
 TINY_QWEN = ModelConfig(name="tiny-qwen", qkv_bias=True)
+
+# 8 experts so the expert axis divides TP-8 like the production MoE preset.
+TINY_MOE = ModelConfig(name="tiny-moe", num_experts=8, num_experts_per_tok=2)
 
 LLAMA3_8B = ModelConfig(
     name="llama3-8b",
@@ -88,6 +102,20 @@ LLAMA3_70B = ModelConfig(
     num_kv_heads=8,
     rope_theta=500_000.0,
     max_seq_len=8192,
+)
+
+MIXTRAL_8X7B = ModelConfig(
+    name="mixtral-8x7b",
+    vocab_size=32_000,
+    hidden_size=4096,
+    intermediate_size=14_336,
+    num_layers=32,
+    num_heads=32,
+    num_kv_heads=8,
+    rope_theta=1_000_000.0,
+    max_seq_len=32_768,
+    num_experts=8,
+    num_experts_per_tok=2,
 )
 
 # Mistral-7B (v0.3+: no sliding window, full GQA) — same skeleton as
@@ -148,8 +176,8 @@ LLAMA_1B = ModelConfig(
 
 PRESETS = {
     c.name: c
-    for c in [TINY, TINY_QWEN, LLAMA3_8B, LLAMA3_70B, MISTRAL_7B,
-              QWEN2_7B, QWEN2_72B, LLAMA_1B]
+    for c in [TINY, TINY_QWEN, TINY_MOE, LLAMA3_8B, LLAMA3_70B, MISTRAL_7B,
+              MIXTRAL_8X7B, QWEN2_7B, QWEN2_72B, LLAMA_1B]
 }
 
 

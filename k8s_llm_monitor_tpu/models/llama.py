@@ -96,23 +96,35 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
             p["bias"] = jnp.zeros((out_f,), dtype)
         return p
 
+    def expert_dense(key, in_f, out_f):
+        # Stacked expert kernels [E, in, out]; leading axis shards over the
+        # mesh's ``model`` axis (expert parallelism).
+        w = jax.random.normal(
+            key, (cfg.num_experts, in_f, out_f), jnp.float32) * (in_f ** -0.5)
+        return {"kernel": w.astype(dtype)}
+
     keys = jax.random.split(rng, 2 + cfg.num_layers)
     layers = []
     for i in range(cfg.num_layers):
-        lk = jax.random.split(keys[2 + i], 7)
-        layers.append(
-            {
-                "input_norm": jnp.ones((H,), dtype),
-                "post_norm": jnp.ones((H,), dtype),
-                "q": dense(lk[0], H, nH * D, cfg.qkv_bias),
-                "k": dense(lk[1], H, nKV * D, cfg.qkv_bias),
-                "v": dense(lk[2], H, nKV * D, cfg.qkv_bias),
-                "o": dense(lk[3], nH * D, H, False),
-                "gate": dense(lk[4], H, I, False),
-                "up": dense(lk[5], H, I, False),
-                "down": dense(lk[6], I, H, False),
-            }
-        )
+        lk = jax.random.split(keys[2 + i], 8)
+        layer = {
+            "input_norm": jnp.ones((H,), dtype),
+            "post_norm": jnp.ones((H,), dtype),
+            "q": dense(lk[0], H, nH * D, cfg.qkv_bias),
+            "k": dense(lk[1], H, nKV * D, cfg.qkv_bias),
+            "v": dense(lk[2], H, nKV * D, cfg.qkv_bias),
+            "o": dense(lk[3], nH * D, H, False),
+        }
+        if cfg.num_experts > 0:
+            layer["router"] = dense(lk[7], H, cfg.num_experts, False)
+            layer["gate_e"] = expert_dense(lk[4], H, I)
+            layer["up_e"] = expert_dense(lk[5], H, I)
+            layer["down_e"] = expert_dense(lk[6], I, H)
+        else:
+            layer["gate"] = dense(lk[4], H, I, False)
+            layer["up"] = dense(lk[5], H, I, False)
+            layer["down"] = dense(lk[6], I, H, False)
+        layers.append(layer)
     params: Params = {
         "embed": {
             "weight": (
@@ -204,7 +216,107 @@ def _qkv(layer: Params, cfg: ModelConfig, x: jnp.ndarray, cos, sin):
     return q, k, v
 
 
+def _moe_mlp(layer: Params, cfg: ModelConfig,
+             x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Mixture-of-experts SwiGLU with GShard capacity dispatch.
+
+    x [B, S, H] -> (y [B, S, H], aux scalar).  Everything is expressed as
+    dense einsums over a static per-expert capacity C, so the computation
+    is one fixed XLA program: with the stacked expert kernels sharded
+    [E over ``model``] and the dispatched activations [E, C, H] sharded the
+    same way, GSPMD inserts the token all-to-alls automatically — expert
+    parallelism with zero manual collectives, the same way the TP specs
+    work (parallel/sharding.py).  Overflow beyond C skips the MLP: the
+    residual connection passes those tokens through unchanged (standard
+    GShard/Switch behavior).
+
+    ``aux`` is the Switch-style load-balancing loss (num_experts * sum of
+    mean router probability x mean dispatch fraction per expert, computed
+    over the top-1 choice); forward_full folds it out for training.
+    """
+    B, S, H = x.shape
+    T = B * S
+    E, K = cfg.num_experts, cfg.num_experts_per_tok
+    # GShard token grouping: dispatch within fixed-size groups so the
+    # one-hot tensors stay O(T) — ungrouped, [T, E, C] with C ~ T*K/E is
+    # quadratic in T and OOMs at long-context training shapes.
+    Tg = next(g for g in (512, 256, 128, 64, 32, 16, 8, 4, 2, 1)
+              if T % g == 0)
+    G = T // Tg
+    C = max(1, -(-Tg * K * int(100 * cfg.capacity_factor) // (100 * E)))
+    xt = x.reshape(G, Tg, H)
+
+    logits = _linear(layer["router"], xt)                      # [G, Tg, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    topv, topi = jax.lax.top_k(probs, K)                       # [G, Tg, K]
+    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)        # renorm
+
+    # Capacity assignment per choice rank within each group: tokens claim
+    # slots in index order; a token whose expert is full at its rank is
+    # dropped (for that choice only).  dispatch [G, Tg, E, C] one-hot;
+    # combine adds the router weight.
+    dispatch = jnp.zeros((G, Tg, E, C), jnp.float32)
+    combine = jnp.zeros((G, Tg, E, C), jnp.float32)
+    used = jnp.zeros((G, E), jnp.int32)     # slots claimed by earlier ranks
+    for j in range(K):
+        mask_j = jax.nn.one_hot(topi[..., j], E, dtype=jnp.float32)
+        pos_j = (jnp.cumsum(mask_j, axis=1) - 1.0
+                 + used[:, None, :].astype(jnp.float32))
+        keep = (pos_j < C) & (mask_j > 0)
+        slot = jax.nn.one_hot(pos_j.astype(jnp.int32), C,
+                              dtype=jnp.float32) * keep[..., None]
+        dispatch = dispatch + mask_j[..., None] * slot
+        combine = combine + (topv[..., j][..., None, None]
+                             * mask_j[..., None] * slot)
+        used = used + jnp.sum(mask_j * keep, axis=1).astype(jnp.int32)
+
+    xs = jnp.einsum("gtec,gth->gech", dispatch.astype(x.dtype), xt)
+    gate = jnp.einsum("gech,ehi->geci", xs, layer["gate_e"]["kernel"])
+    up = jnp.einsum("gech,ehi->geci", xs, layer["up_e"]["kernel"])
+    ys = jnp.einsum("geci,eih->gech", jax.nn.silu(gate) * up,
+                    layer["down_e"]["kernel"])
+    y = jnp.einsum("gtec,gech->gth", combine.astype(x.dtype), ys)
+
+    # Load balance on the top-1 assignment (Switch Transformer eq. 4).
+    top1 = jax.nn.one_hot(topi[..., 0].reshape(-1), E, dtype=jnp.float32)
+    aux = E * jnp.sum(jnp.mean(top1, axis=0)
+                      * jnp.mean(probs.reshape(-1, E), axis=0))
+    return y.reshape(B, S, H), aux
+
+
+def _moe_mlp_dropless(layer: Params, cfg: ModelConfig,
+                      x: jnp.ndarray) -> jnp.ndarray:
+    """Dropless MoE for inference: every token gets its full top-k experts.
+
+    The capacity dispatch above is a TRAINING convention — at inference a
+    capacity drop would make a request's output depend on what else is
+    co-batched (and diverge from HF Mixtral, which is dropless).  This
+    path loops the (static, small) expert count, runs each expert's SwiGLU
+    on all tokens, and weights by the router — E/K more MLP FLOPs, which
+    decode never notices (it is bound by streaming the expert weights,
+    paid identically either way) and prefill accepts for exactness.
+    """
+    B, S, H = x.shape
+    E, K = cfg.num_experts, cfg.num_experts_per_tok
+    logits = _linear(layer["router"], x)                       # [B, S, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    topv, topi = jax.lax.top_k(probs, K)
+    topv = topv / jnp.sum(topv, axis=-1, keepdims=True)
+    # Router weights scattered back to [B, S, E] (zero for unchosen).
+    w = jnp.sum(jax.nn.one_hot(topi, E, dtype=jnp.float32)
+                * topv[..., None], axis=2)
+    out = jnp.zeros_like(x)
+    for e in range(E):
+        g = x @ layer["gate_e"]["kernel"][e]
+        u = x @ layer["up_e"]["kernel"][e]
+        ye = (jax.nn.silu(g) * u) @ layer["down_e"]["kernel"][e]
+        out = out + w[..., e:e + 1].astype(x.dtype) * ye
+    return out
+
+
 def _mlp(layer: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    if cfg.num_experts > 0:
+        return _moe_mlp_dropless(layer, cfg, x)
     aq = cfg.act_quant
     gate = _linear(layer["gate"], x, aq)
     up = _linear(layer["up"], x, aq)
@@ -234,6 +346,40 @@ def _unembed(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
 # ---------------------------------------------------------------------------
 
 
+def layer_block(
+    layer: Params,
+    cfg: ModelConfig,
+    x: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    positions: jnp.ndarray,
+    attn_fn=None,
+    collect_aux: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """One transformer layer (norm/QKV/attention/residual/MLP) — the single
+    definition shared by forward_full and the pipeline stage scan
+    (parallel/pipeline.py), so the layer semantics cannot drift between
+    the dense and pipelined paths.
+
+    ``collect_aux`` selects the TRAINING MoE path (capacity dispatch +
+    load-balance aux); otherwise MoE configs run the dropless inference
+    path.  Returns (x, aux scalar — 0.0 unless collecting).
+    """
+    if attn_fn is None:
+        attn_fn = causal_attention
+    B, S = x.shape[:2]
+    h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+    q, k, v = _qkv(layer, cfg, h, cos, sin)
+    attn = attn_fn(q, k, v, q_positions=positions)
+    x = x + _linear(layer["o"], attn.reshape(B, S, -1), cfg.act_quant)
+    h = rms_norm(x, layer["post_norm"], cfg.rms_norm_eps)
+    if cfg.num_experts > 0 and collect_aux:
+        y, aux = _moe_mlp(layer, cfg, h)
+    else:
+        y, aux = _mlp(layer, cfg, h), jnp.zeros((), jnp.float32)
+    return x + y, aux
+
+
 def forward_full(
     params: Params,
     cfg: ModelConfig,
@@ -241,29 +387,35 @@ def forward_full(
     *,
     positions: Optional[jnp.ndarray] = None,
     attn_fn=None,
+    return_aux: bool = False,
 ) -> jnp.ndarray:
     """Dense causal forward.  tokens [B, S] -> logits [B, S, V] (float32).
 
     ``attn_fn`` swaps the attention implementation (default dense
     ``causal_attention``; pass ``parallel.ring_attention.make_ring_attention``
     output for sequence-parallel long-context training).
+
+    ``return_aux`` additionally returns the mean MoE load-balancing loss
+    over layers (0.0 for dense models) — the training path folds it into
+    the objective.  It also selects the MoE TRAINING dispatch (GShard
+    capacity, tokens can drop); without it MoE runs dropless (inference
+    semantics, HF parity).
     """
-    if attn_fn is None:
-        attn_fn = causal_attention
     B, S = tokens.shape
     x = _embed_lookup(params, cfg, tokens)
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     cos, sin = rope_angles(positions, cfg.head_dim_, cfg.rope_theta,
                            scaling=cfg.rope_scaling)
+    aux_total = jnp.zeros((), jnp.float32)
     for layer in params["layers"]:
-        h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
-        q, k, v = _qkv(layer, cfg, h, cos, sin)
-        attn = attn_fn(q, k, v, q_positions=positions)
-        x = x + _linear(layer["o"], attn.reshape(B, S, -1), cfg.act_quant)
-        h = rms_norm(x, layer["post_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(layer, cfg, h)
-    return _unembed(params, cfg, x)
+        x, aux = layer_block(layer, cfg, x, cos, sin, positions,
+                             attn_fn=attn_fn, collect_aux=return_aux)
+        aux_total = aux_total + aux
+    logits = _unembed(params, cfg, x)
+    if return_aux:
+        return logits, aux_total / max(len(params["layers"]), 1)
+    return logits
 
 
 # ---------------------------------------------------------------------------

@@ -64,8 +64,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from k8s_llm_monitor_tpu.models import llama
 from k8s_llm_monitor_tpu.models.config import ModelConfig
-from k8s_llm_monitor_tpu.ops.attention import causal_attention
-from k8s_llm_monitor_tpu.ops.norms import rms_norm
 from k8s_llm_monitor_tpu.ops.rope import rope_angles
 
 
@@ -140,7 +138,9 @@ def place_pipeline_opt_state(opt_state, n_stages: int, mesh: Mesh):
 
 def _run_stage(cfg: ModelConfig, stage_layers, x: jnp.ndarray) -> jnp.ndarray:
     """Scan this device's layer block over x [mb, S, H] (dense causal
-    attention — stages see whole sequences)."""
+    attention — stages see whole sequences).  The per-layer math is
+    llama.layer_block, shared with forward_full so the pipelined model
+    cannot drift from the dense one."""
     mb, S, _ = x.shape
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (mb, S))
     cos, sin = rope_angles(positions, cfg.head_dim_, cfg.rope_theta,
@@ -148,13 +148,7 @@ def _run_stage(cfg: ModelConfig, stage_layers, x: jnp.ndarray) -> jnp.ndarray:
 
     @jax.checkpoint
     def body(h, lyr):
-        a = rms_norm(h, lyr["input_norm"], cfg.rms_norm_eps)
-        q, k, v = llama._qkv(lyr, cfg, a, cos, sin)
-        attn = causal_attention(q, k, v, q_positions=positions)
-        h = h + llama._linear(lyr["o"], attn.reshape(mb, S, -1),
-                              cfg.act_quant)
-        a = rms_norm(h, lyr["post_norm"], cfg.rms_norm_eps)
-        h = h + llama._mlp(lyr, cfg, a)
+        h, _ = llama.layer_block(lyr, cfg, h, cos, sin, positions)
         return h, None
 
     x, _ = jax.lax.scan(body, x, stage_layers)
@@ -169,6 +163,12 @@ def make_pipeline_forward(mesh: Mesh, cfg: ModelConfig):
     ``data`` by GSPMD) and ``hidden`` is the post-layer-stack activation
     with identical sharding, replicated over ``pipe``.
     """
+    if cfg.num_experts > 0:
+        raise NotImplementedError(
+            "pipeline parallelism does not thread the MoE load-balancing "
+            "aux loss yet — train MoE configs on the GSPMD data x model "
+            "mesh (expert parallelism, training/train.py) instead")
+
     def fn(staged_layers, x0):
         in_layer_specs = jax.tree.map(
             lambda x: P("pipe", *([None] * (x.ndim - 1))), staged_layers)

@@ -33,6 +33,9 @@ _COL = {"q", "k", "v", "gate", "up", "lm_head"}   # kernel [in, out] -> shard ou
 _ROW = {"o", "down"}                               # kernel [in, out] -> shard in
 
 
+_EXPERT = {"gate_e", "up_e", "down_e"}   # stacked [E, in, out] kernels
+
+
 def _spec_for_path(path: tuple) -> P:
     keys = [p.key for p in path if isinstance(p, jax.tree_util.DictKey)]
     leaf = keys[-1]
@@ -41,6 +44,12 @@ def _spec_for_path(path: tuple) -> P:
         return P("model", None)                    # vocab-parallel
     if parent == "embed" and leaf == "scale":
         return P("model")                          # per-vocab-row scales
+    if parent in _EXPERT:
+        # Expert parallelism: the expert axis rides ``model`` — GSPMD
+        # inserts the dispatch/combine all-to-alls from this annotation
+        # (models/llama.py:_moe_mlp).  The router stays replicated (it is
+        # O(H x E) and every token needs it).
+        return P("model", None, None)
     if leaf in ("kernel", "kernel_q"):
         if parent in _COL:
             return P(None, "model")

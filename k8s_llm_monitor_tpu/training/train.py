@@ -111,19 +111,27 @@ def next_token_loss(
     loss_mask: jnp.ndarray | None = None,
     attn_fn=None,
 ) -> jnp.ndarray:
-    """Mean next-token cross-entropy over ``tokens`` [B, S] int32."""
+    """Mean next-token cross-entropy over ``tokens`` [B, S] int32.
+
+    MoE configs add 0.01 x the router load-balancing aux loss (the Switch
+    Transformer coefficient) so training pressure keeps experts utilized.
+    """
     # Forward the full sequence and drop the last position's logits (rather
     # than slicing the input) so S keeps its seq-axis divisibility for the
     # ring-attention path; the extra position costs 1/S more compute.
-    logits = llama.forward_full(
-        params, cfg, tokens, attn_fn=attn_fn)[:, :-1]  # [B, S-1, V]
+    moe = cfg.num_experts > 0
+    out = llama.forward_full(
+        params, cfg, tokens, attn_fn=attn_fn, return_aux=moe)
+    logits, aux = out if moe else (out, 0.0)
+    logits = logits[:, :-1]                            # [B, S-1, V]
     targets = tokens[:, 1:]
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     if loss_mask is not None:
         mask = loss_mask[:, 1:].astype(jnp.float32)
-        return -jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
-    return -jnp.mean(ll)
+        return (-jnp.sum(ll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+                + 0.01 * aux)
+    return -jnp.mean(ll) + 0.01 * aux
 
 
 def make_train_step(

@@ -41,6 +41,9 @@ def config_from_hf(hf: Mapping[str, Any], name: str = "hf-model") -> ModelConfig
         max_seq_len=hf.get("max_position_embeddings", 8192),
         qkv_bias=hf.get("model_type") == "qwen2",
         tie_embeddings=hf.get("tie_word_embeddings", False),
+        # Mixtral: MoE geometry from the HF keys (0/absent = dense).
+        num_experts=hf.get("num_local_experts", 0),
+        num_experts_per_tok=hf.get("num_experts_per_tok", 2),
     )
 
 
@@ -88,6 +91,15 @@ def convert_hf_state_dict(
             p["bias"] = get(bias_key)
         return p
 
+    def expert_stack(pre: str, hf_name: str) -> Params:
+        # Mixtral: block_sparse_moe.experts.<e>.{w1,w3,w2} -> stacked
+        # [E, in, out] (w1=gate, w3=up, w2=down).  Expert stacks stay in
+        # the dense dtype (see utils/quantize.py MoE note).
+        ws = [np.asarray(
+            state[f"{pre}block_sparse_moe.experts.{e}.{hf_name}.weight"]).T
+            for e in range(cfg.num_experts)]
+        return {"kernel": jnp.asarray(np.stack(ws), dtype=dt)}
+
     layers = []
     for i in range(cfg.num_layers):
         pre = f"model.layers.{i}."
@@ -96,8 +108,16 @@ def convert_hf_state_dict(
             "post_norm": get(pre + "post_attention_layernorm.weight"),
         }
         for ours, theirs in _LINEAR_MAP.items():
+            if cfg.num_experts > 0 and ours in ("gate", "up", "down"):
+                continue
             layer[ours] = linear(f"{pre}{theirs}.weight",
                                  f"{pre}{theirs}.bias")
+        if cfg.num_experts > 0:
+            layer["router"] = {"kernel": jnp.asarray(np.asarray(
+                state[f"{pre}block_sparse_moe.gate.weight"]).T, dtype=dt)}
+            layer["gate_e"] = expert_stack(pre, "w1")
+            layer["up_e"] = expert_stack(pre, "w3")
+            layer["down_e"] = expert_stack(pre, "w2")
         layers.append(layer)
 
     if quantize:

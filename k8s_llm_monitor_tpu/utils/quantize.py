@@ -94,8 +94,18 @@ def quantize_params(params: Params) -> Params:
             "input_norm": layer["input_norm"],
             "post_norm": layer["post_norm"],
         }
-        for name in ("q", "k", "v", "o", "gate", "up", "down"):
+        for name in ("q", "k", "v", "o"):
             ql[name] = quantize_linear(layer[name])
+        if "router" in layer:
+            # MoE layers: attention quantizes as usual; the router and the
+            # stacked [E, in, out] expert kernels stay bf16 (per-channel
+            # int8 for 3D expert stacks is a future extension — experts
+            # already divide memory E ways across the mesh).
+            for name in ("router", "gate_e", "up_e", "down_e"):
+                ql[name] = layer[name]
+        else:
+            for name in ("gate", "up", "down"):
+                ql[name] = quantize_linear(layer[name])
         layers.append(ql)
     out: Params = {
         "embed": quantize_embed(params["embed"]),
@@ -133,6 +143,12 @@ def init_params_quantized(rng: jax.Array, cfg: ModelConfig) -> Params:
         if bias:
             p["bias"] = jnp.zeros((out_f,), dtype)
         return p
+
+    if cfg.num_experts > 0:
+        raise NotImplementedError(
+            "MoE configs random-init in bf16 (models/llama.py:init_params) "
+            "then quantize_params the attention; 3D expert-stack int8 is a "
+            "future extension")
 
     keys = jax.random.split(rng, 2 + cfg.num_layers)
     layers = []
