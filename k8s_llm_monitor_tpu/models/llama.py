@@ -291,10 +291,18 @@ def _moe_mlp_dropless(layer: Params, cfg: ModelConfig,
     The capacity dispatch above is a TRAINING convention — at inference a
     capacity drop would make a request's output depend on what else is
     co-batched (and diverge from HF Mixtral, which is dropless).  This
-    path loops the (static, small) expert count, runs each expert's SwiGLU
-    on all tokens, and weights by the router — E/K more MLP FLOPs, which
-    decode never notices (it is bound by streaming the expert weights,
-    paid identically either way) and prefill accepts for exactness.
+    path runs every expert's SwiGLU on all tokens as STACKED einsums over
+    the expert axis and contracts against the scattered router weights —
+    E/K more MLP FLOPs than routed dispatch, which decode never notices
+    (it is bound by streaming the expert weights, paid identically either
+    way).  Keeping E as an einsum axis (never a Python-loop index) is what
+    preserves expert parallelism on a serving mesh: each device computes
+    only its local expert shard over the (model-replicated) activations,
+    and the final contraction over E becomes the GSPMD psum — a per-expert
+    slice loop would instead all-gather every expert's kernel to every
+    device.  The [E, B, S, I] transient is per-device E/tp-sliced; on a
+    single chip it bounds the dropless chunk size (tiny test configs and
+    decode shapes are fine — Mixtral-class weights need a mesh anyway).
     """
     B, S, H = x.shape
     E, K = cfg.num_experts, cfg.num_experts_per_tok
@@ -305,13 +313,11 @@ def _moe_mlp_dropless(layer: Params, cfg: ModelConfig,
     # Router weights scattered back to [B, S, E] (zero for unchosen).
     w = jnp.sum(jax.nn.one_hot(topi, E, dtype=jnp.float32)
                 * topv[..., None], axis=2)
-    out = jnp.zeros_like(x)
-    for e in range(E):
-        g = x @ layer["gate_e"]["kernel"][e]
-        u = x @ layer["up_e"]["kernel"][e]
-        ye = (jax.nn.silu(g) * u) @ layer["down_e"]["kernel"][e]
-        out = out + w[..., e:e + 1].astype(x.dtype) * ye
-    return out
+    gate = jnp.einsum("bsh,ehi->ebsi", x, layer["gate_e"]["kernel"])
+    up = jnp.einsum("bsh,ehi->ebsi", x, layer["up_e"]["kernel"])
+    ys = jnp.einsum("ebsi,eih->ebsh", jax.nn.silu(gate) * up,
+                    layer["down_e"]["kernel"])
+    return jnp.einsum("ebsh,bse->bsh", ys, w.astype(x.dtype))
 
 
 def _mlp(layer: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
