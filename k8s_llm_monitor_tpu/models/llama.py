@@ -216,6 +216,14 @@ def _qkv(layer: Params, cfg: ModelConfig, x: jnp.ndarray, cos, sin):
     return q, k, v
 
 
+def _expert_weights(p: Params, dtype):
+    """Expert kernel stack for einsum use: bf16 passthrough, or the int8
+    stack (cast fuses into the MXU operand read) + its [E, out] scales."""
+    if "kernel_q" in p:
+        return p["kernel_q"].astype(dtype), p["scale"]
+    return p["kernel"], None
+
+
 def _moe_mlp(layer: Params, cfg: ModelConfig,
              x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     """Mixture-of-experts SwiGLU with GShard capacity dispatch.
@@ -271,10 +279,40 @@ def _moe_mlp(layer: Params, cfg: ModelConfig,
         used = used + jnp.sum(mask_j * keep, axis=1).astype(jnp.int32)
 
     xs = jnp.einsum("gtec,gth->gech", dispatch.astype(x.dtype), xt)
-    gate = jnp.einsum("gech,ehi->geci", xs, layer["gate_e"]["kernel"])
-    up = jnp.einsum("gech,ehi->geci", xs, layer["up_e"]["kernel"])
-    ys = jnp.einsum("geci,eih->gech", jax.nn.silu(gate) * up,
-                    layer["down_e"]["kernel"])
+    if cfg.act_quant and "kernel_q" in layer["gate_e"]:
+        # W8A8 experts: s8 x s8 -> s32 on the MXU int8 path, same contract
+        # as _linear (activation scale per token row, weight scale per
+        # (expert, out-channel), both factor out of the contraction).
+        xs_q, xs_s = _quant_act(xs)
+        gate = (jnp.einsum("gech,ehi->geci", xs_q,
+                           layer["gate_e"]["kernel_q"],
+                           preferred_element_type=jnp.int32)
+                .astype(jnp.float32) * xs_s
+                * layer["gate_e"]["scale"][None, :, None, :]).astype(x.dtype)
+        up = (jnp.einsum("gech,ehi->geci", xs_q,
+                         layer["up_e"]["kernel_q"],
+                         preferred_element_type=jnp.int32)
+              .astype(jnp.float32) * xs_s
+              * layer["up_e"]["scale"][None, :, None, :]).astype(x.dtype)
+        h2 = jax.nn.silu(gate) * up
+        h2_q, h2_s = _quant_act(h2)
+        ys = (jnp.einsum("geci,eih->gech", h2_q,
+                         layer["down_e"]["kernel_q"],
+                         preferred_element_type=jnp.int32)
+              .astype(jnp.float32) * h2_s
+              * layer["down_e"]["scale"][None, :, None, :]).astype(x.dtype)
+    else:
+        gk, gs = _expert_weights(layer["gate_e"], x.dtype)
+        uk, us = _expert_weights(layer["up_e"], x.dtype)
+        dk, ds = _expert_weights(layer["down_e"], x.dtype)
+        gate = jnp.einsum("gech,ehi->geci", xs, gk)
+        up = jnp.einsum("gech,ehi->geci", xs, uk)
+        if gs is not None:   # weight-only int8: dequant on the result
+            gate = gate * gs[None, :, None, :].astype(gate.dtype)
+            up = up * us[None, :, None, :].astype(up.dtype)
+        ys = jnp.einsum("geci,eih->gech", jax.nn.silu(gate) * up, dk)
+        if ds is not None:
+            ys = ys * ds[None, :, None, :].astype(ys.dtype)
     y = jnp.einsum("gtec,gech->gth", combine.astype(x.dtype), ys)
 
     # Load balance on the top-1 assignment (Switch Transformer eq. 4).
@@ -313,10 +351,40 @@ def _moe_mlp_dropless(layer: Params, cfg: ModelConfig,
     # Router weights scattered back to [B, S, E] (zero for unchosen).
     w = jnp.sum(jax.nn.one_hot(topi, E, dtype=jnp.float32)
                 * topv[..., None], axis=2)
-    gate = jnp.einsum("bsh,ehi->ebsi", x, layer["gate_e"]["kernel"])
-    up = jnp.einsum("bsh,ehi->ebsi", x, layer["up_e"]["kernel"])
-    ys = jnp.einsum("ebsi,eih->ebsh", jax.nn.silu(gate) * up,
-                    layer["down_e"]["kernel"])
+    if cfg.act_quant and "kernel_q" in layer["gate_e"]:
+        # W8A8 experts (see _moe_mlp): s8 x s8 MXU path for the dominant
+        # MLP FLOPs — without this, quantize=w8a8 on MoE models would
+        # silently run bf16 expert matmuls.
+        x_q, x_s = _quant_act(x)
+        gate = (jnp.einsum("bsh,ehi->ebsi", x_q,
+                           layer["gate_e"]["kernel_q"],
+                           preferred_element_type=jnp.int32)
+                .astype(jnp.float32) * x_s[None]
+                * layer["gate_e"]["scale"][:, None, None, :]).astype(x.dtype)
+        up = (jnp.einsum("bsh,ehi->ebsi", x_q,
+                         layer["up_e"]["kernel_q"],
+                         preferred_element_type=jnp.int32)
+              .astype(jnp.float32) * x_s[None]
+              * layer["up_e"]["scale"][:, None, None, :]).astype(x.dtype)
+        h2 = jax.nn.silu(gate) * up
+        h2_q, h2_s = _quant_act(h2)
+        ys = (jnp.einsum("ebsi,eih->ebsh", h2_q,
+                         layer["down_e"]["kernel_q"],
+                         preferred_element_type=jnp.int32)
+              .astype(jnp.float32) * h2_s
+              * layer["down_e"]["scale"][:, None, None, :]).astype(x.dtype)
+    else:
+        gk, gs = _expert_weights(layer["gate_e"], x.dtype)
+        uk, us = _expert_weights(layer["up_e"], x.dtype)
+        dk, ds = _expert_weights(layer["down_e"], x.dtype)
+        gate = jnp.einsum("bsh,ehi->ebsi", x, gk)
+        up = jnp.einsum("bsh,ehi->ebsi", x, uk)
+        if gs is not None:   # weight-only int8: dequant on the result
+            gate = gate * gs[:, None, None, :].astype(gate.dtype)
+            up = up * us[:, None, None, :].astype(up.dtype)
+        ys = jnp.einsum("ebsi,eih->ebsh", jax.nn.silu(gate) * up, dk)
+        if ds is not None:
+            ys = ys * ds[:, None, None, :].astype(ys.dtype)
     return jnp.einsum("ebsh,bse->bsh", ys, w.astype(x.dtype))
 
 

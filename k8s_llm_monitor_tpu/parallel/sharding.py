@@ -47,8 +47,11 @@ def _spec_for_path(path: tuple) -> P:
     if parent in _EXPERT:
         # Expert parallelism: the expert axis rides ``model`` — GSPMD
         # inserts the dispatch/combine all-to-alls from this annotation
-        # (models/llama.py:_moe_mlp).  The router stays replicated (it is
-        # O(H x E) and every token needs it).
+        # (models/llama.py:_moe_mlp).  Kernels are [E, in, out]; int8
+        # scales are [E, out] and shard their expert axis the same way.
+        # The router stays replicated (O(H x E), every token needs it).
+        if leaf == "scale":
+            return P("model", None)
         return P("model", None, None)
     if leaf in ("kernel", "kernel_q"):
         if parent in _COL:

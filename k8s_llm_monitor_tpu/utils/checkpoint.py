@@ -93,12 +93,17 @@ def convert_hf_state_dict(
 
     def expert_stack(pre: str, hf_name: str) -> Params:
         # Mixtral: block_sparse_moe.experts.<e>.{w1,w3,w2} -> stacked
-        # [E, in, out] (w1=gate, w3=up, w2=down).  Expert stacks stay in
-        # the dense dtype (see utils/quantize.py MoE note).
-        ws = [np.asarray(
+        # [E, in, out] (w1=gate, w3=up, w2=down).
+        ws = np.stack([np.asarray(
             state[f"{pre}block_sparse_moe.experts.{e}.{hf_name}.weight"]).T
-            for e in range(cfg.num_experts)]
-        return {"kernel": jnp.asarray(np.stack(ws), dtype=dt)}
+            for e in range(cfg.num_experts)])
+        if quantize:
+            from k8s_llm_monitor_tpu.utils.quantize import (
+                quantize_expert_stack,
+            )
+
+            return quantize_expert_stack({"kernel": ws})
+        return {"kernel": jnp.asarray(ws, dtype=dt)}
 
     layers = []
     for i in range(cfg.num_layers):

@@ -82,6 +82,17 @@ def quantize_embed(p: Params) -> Params:
     return {"weight_q": jnp.asarray(w_q), "scale": jnp.asarray(scale)}
 
 
+def quantize_expert_stack(p: Params) -> Params:
+    """{"kernel": [E, in, out]} -> {"kernel_q" int8, "scale" [E, out]}.
+
+    Per-expert per-output-channel symmetric int8 — the exact analogue of
+    quantize_linear with the expert axis carried through; dequant stays a
+    per-(expert, out) multiply on the einsum result (models/llama.py).
+    """
+    w_q, scale = quantize_array(np.asarray(p["kernel"]), axis=1)
+    return {"kernel_q": jnp.asarray(w_q), "scale": jnp.asarray(scale)}
+
+
 def quantize_params(params: Params) -> Params:
     """Quantize a full llama param pytree (see models/llama.py layout).
 
@@ -97,12 +108,11 @@ def quantize_params(params: Params) -> Params:
         for name in ("q", "k", "v", "o"):
             ql[name] = quantize_linear(layer[name])
         if "router" in layer:
-            # MoE layers: attention quantizes as usual; the router and the
-            # stacked [E, in, out] expert kernels stay bf16 (per-channel
-            # int8 for 3D expert stacks is a future extension — experts
-            # already divide memory E ways across the mesh).
-            for name in ("router", "gate_e", "up_e", "down_e"):
-                ql[name] = layer[name]
+            # MoE layers: the router stays bf16 (tiny, routing-decision
+            # sensitive); expert stacks quantize per-expert-per-channel.
+            ql["router"] = layer["router"]
+            for name in ("gate_e", "up_e", "down_e"):
+                ql[name] = quantize_expert_stack(layer[name])
         else:
             for name in ("gate", "up", "down"):
                 ql[name] = quantize_linear(layer[name])
@@ -145,10 +155,14 @@ def init_params_quantized(rng: jax.Array, cfg: ModelConfig) -> Params:
         return p
 
     if cfg.num_experts > 0:
-        raise NotImplementedError(
-            "MoE configs random-init in bf16 (models/llama.py:init_params) "
-            "then quantize_params the attention; 3D expert-stack int8 is a "
-            "future extension")
+        # MoE: bf16 init then quantize (the direct-int8 trick below skips
+        # the bf16 materialization, but expert stacks need the real value
+        # distribution for per-expert scales; the transient bf16 peak is
+        # fine at dev/random-init scales — real MoE checkpoints stream
+        # through convert_hf_state_dict(quantize=True) tensor-by-tensor).
+        from k8s_llm_monitor_tpu.models.llama import init_params
+
+        return quantize_params(init_params(rng, cfg))
 
     keys = jax.random.split(rng, 2 + cfg.num_layers)
     layers = []

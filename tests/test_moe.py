@@ -193,6 +193,75 @@ def test_moe_expert_specs_shard_tp8():
         assert E % 8 == 0
 
 
+def test_moe_int8_expert_parity(params):
+    """quantize_params int8 expert stacks: logits track the bf16 MoE model
+    closely (same contract as the dense int8 parity tests) and the
+    quantized tree serves through the engine."""
+    from k8s_llm_monitor_tpu.serving.engine import (
+        EngineConfig,
+        InferenceEngine,
+        SamplingParams,
+    )
+    from k8s_llm_monitor_tpu.utils.quantize import quantize_params
+
+    qp = quantize_params(params)
+    lyr = qp["layers"][0]
+    assert lyr["gate_e"]["kernel_q"].dtype == jnp.int8
+    assert lyr["gate_e"]["scale"].shape == (CFG.num_experts,
+                                            CFG.intermediate_size)
+    assert "kernel" in lyr["router"]          # router stays bf16
+
+    rng = np.random.default_rng(8)
+    tokens = jnp.asarray(rng.integers(2, 200, size=(2, 8)), jnp.int32)
+    a = llama.forward_full(params, CFG, tokens)
+    b = llama.forward_full(qp, CFG, tokens)
+    af, bf = np.asarray(a).reshape(-1), np.asarray(b).reshape(-1)
+    cos = float(af @ bf / (np.linalg.norm(af) * np.linalg.norm(bf)))
+    assert cos > 0.999, f"int8 MoE logits diverged (cosine {cos})"
+
+    eng = InferenceEngine(
+        CFG, qp,
+        EngineConfig(max_slots=2, num_blocks=64, block_size=8,
+                     max_blocks_per_seq=16, prefill_buckets=(16,)),
+        eos_id=-1)
+    res = eng.generate([list(rng.integers(2, 200, size=6))],
+                       SamplingParams(max_tokens=6, temperature=0.0))
+    assert res[0].finish_reason == "length"
+
+
+def test_moe_w8a8_expert_parity(params):
+    """act_quant on int8 experts routes the MLP through the s8 x s8 einsum
+    path; logits must track the bf16 model (same cosine contract as the
+    dense W8A8 parity test)."""
+    from k8s_llm_monitor_tpu.utils.quantize import quantize_params
+
+    qp = quantize_params(params)
+    cfg_aq = dataclasses.replace(CFG, act_quant=True)
+    rng = np.random.default_rng(9)
+    tokens = jnp.asarray(rng.integers(2, 200, size=(2, 8)), jnp.int32)
+    a = llama.forward_full(params, CFG, tokens)
+    b = llama.forward_full(qp, cfg_aq, tokens)
+    af, bf = np.asarray(a).reshape(-1), np.asarray(b).reshape(-1)
+    cos = float(af @ bf / (np.linalg.norm(af) * np.linalg.norm(bf)))
+    assert cos > 0.995, f"W8A8 MoE logits diverged (cosine {cos})"
+
+
+def test_moe_int8_specs_shard_tp8():
+    """Quantized expert leaves (kernel_q [E,in,out], scale [E,out]) shard
+    their expert axis over ``model``."""
+    from k8s_llm_monitor_tpu.parallel.sharding import param_partition_specs
+    from k8s_llm_monitor_tpu.utils.quantize import quantize_params
+
+    cfg = dataclasses.replace(CFG, num_experts=8)
+    p = llama.init_params(jax.random.PRNGKey(2), cfg)
+    specs = param_partition_specs(quantize_params(p))
+    lyr = specs["layers"][0]
+    assert lyr["gate_e"]["kernel_q"] == jax.sharding.PartitionSpec(
+        "model", None, None)
+    assert lyr["gate_e"]["scale"] == jax.sharding.PartitionSpec(
+        "model", None)
+
+
 def test_mixtral_hf_key_map_loads():
     """convert_hf_state_dict maps block_sparse_moe.{gate,experts.N.w1/w2/w3}
     into router/gate_e/up_e/down_e stacks."""
