@@ -216,9 +216,20 @@ def _qkv(layer: Params, cfg: ModelConfig, x: jnp.ndarray, cos, sin):
     return q, k, v
 
 
-def _expert_weights(p: Params, dtype):
+def _expert_weights(p: Params, dtype, act_quant: bool = False):
     """Expert kernel stack for einsum use: bf16 passthrough, or the int8
     stack (cast fuses into the MXU operand read) + its [E, out] scales."""
+    if act_quant and "kernel_q" not in p:
+        # Trace-time check, mirroring _linear: the MoE MLP is the dominant
+        # FLOPs — silently running it bf16 under act_quant would hide the
+        # misconfiguration behind benchmarks showing no W8A8 speedup.
+        import warnings
+
+        warnings.warn(
+            "act_quant=True but expert stacks are not int8-quantized "
+            "(no kernel_q); MoE MLP runs the bf16 path — quantize the "
+            "params (utils/quantize.py) for the s8 x s8 MXU speedup",
+            stacklevel=2)
     if "kernel_q" in p:
         return p["kernel_q"].astype(dtype), p["scale"]
     return p["kernel"], None
@@ -302,7 +313,7 @@ def _moe_mlp(layer: Params, cfg: ModelConfig,
               .astype(jnp.float32) * h2_s
               * layer["down_e"]["scale"][None, :, None, :]).astype(x.dtype)
     else:
-        gk, gs = _expert_weights(layer["gate_e"], x.dtype)
+        gk, gs = _expert_weights(layer["gate_e"], x.dtype, cfg.act_quant)
         uk, us = _expert_weights(layer["up_e"], x.dtype)
         dk, ds = _expert_weights(layer["down_e"], x.dtype)
         gate = jnp.einsum("gech,ehi->geci", xs, gk)
@@ -374,7 +385,7 @@ def _moe_mlp_dropless(layer: Params, cfg: ModelConfig,
               .astype(jnp.float32) * h2_s
               * layer["down_e"]["scale"][:, None, None, :]).astype(x.dtype)
     else:
-        gk, gs = _expert_weights(layer["gate_e"], x.dtype)
+        gk, gs = _expert_weights(layer["gate_e"], x.dtype, cfg.act_quant)
         uk, us = _expert_weights(layer["up_e"], x.dtype)
         dk, ds = _expert_weights(layer["down_e"], x.dtype)
         gate = jnp.einsum("bsh,ehi->ebsi", x, gk)

@@ -53,10 +53,10 @@ class TPULLMConfig:
     # multi-minute prefill/decode compile ladder.  '' disables.
     compile_cache_dir: str = ".jax_cache"
     # Prompt-lookup speculative decoding draft length (serving/spec.py);
-    # 0 disables.  Greedy and pure-temperature requests (the diagnosis
-    # default) emit up to spec_k+1 tokens per verify forward when the
-    # output quotes its context (diagnosis answers do); top-k/top-p
-    # requests fall back to the fused scan path automatically.
+    # 0 disables.  Every sampling mode speculates (greedy bit-identically;
+    # sampled — incl. top-k/top-p — distribution-exactly), emitting up to
+    # spec_k+1 tokens per verify forward when the output quotes its
+    # context (diagnosis answers do).
     spec_k: int = 4
 
 

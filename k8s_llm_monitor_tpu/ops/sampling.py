@@ -25,31 +25,23 @@ def greedy_tokens(logits: jnp.ndarray) -> jnp.ndarray:
     return jnp.argmax(logits, axis=-1).astype(jnp.int32)
 
 
-def sample_tokens(
-    rng: jax.Array,
+def filtered_scaled_logits(
     logits: jnp.ndarray,
     *,
     temperature: jnp.ndarray,
     top_k: jnp.ndarray,
     top_p: jnp.ndarray,
 ) -> jnp.ndarray:
-    """Sample next tokens from final-position logits.
+    """Temperature-scale then top-k/top-p-mask logits: the SINGLE
+    definition of the sampling distribution, shared by ``sample_tokens``
+    and the speculative-decode acceptance (serving/spec.py) so the
+    speculated and sequential chains target the identical distribution.
 
-    Args:
-      rng: PRNG key.
-      logits: [B, V] float.
-      temperature: [B] float; <= 0 means greedy (argmax).
-      top_k: [B] int32; <= 0 disables top-k.
-      top_p: [B] float; >= 1.0 disables nucleus filtering.
-
-    Returns:
-      [B] int32 token ids.
+    Args: logits [B, V]; temperature/top_k/top_p [B] (semantics as in
+    ``sample_tokens``).  Returns [B, V] f32, filtered entries -inf.
     """
     B, V = logits.shape
     logits = logits.astype(jnp.float32)
-
-    greedy = greedy_tokens(logits)
-
     temp = jnp.maximum(temperature, 1e-6)[:, None]
     scaled = logits / temp
 
@@ -76,7 +68,31 @@ def sample_tokens(
     n_keep = jnp.where(top_p < 1.0, jnp.maximum(n_keep, 1), V)[:, None]
 
     keep = rank < jnp.minimum(k, n_keep)
-    filtered = jnp.where(keep, scaled, -jnp.inf)
+    return jnp.where(keep, scaled, -jnp.inf)
 
+
+def sample_tokens(
+    rng: jax.Array,
+    logits: jnp.ndarray,
+    *,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+) -> jnp.ndarray:
+    """Sample next tokens from final-position logits.
+
+    Args:
+      rng: PRNG key.
+      logits: [B, V] float.
+      temperature: [B] float; <= 0 means greedy (argmax).
+      top_k: [B] int32; <= 0 disables top-k.
+      top_p: [B] float; >= 1.0 disables nucleus filtering.
+
+    Returns:
+      [B] int32 token ids.
+    """
+    greedy = greedy_tokens(logits)
+    filtered = filtered_scaled_logits(
+        logits, temperature=temperature, top_k=top_k, top_p=top_p)
     sampled = jax.random.categorical(rng, filtered, axis=-1).astype(jnp.int32)
     return jnp.where(temperature <= 0.0, greedy, sampled)

@@ -133,9 +133,10 @@ class EngineConfig:
     # already generating.  1 = strict alternation, large = prefill-first.
     decode_every_n_chunk_rounds: int = 3
     # Prompt-lookup speculative decoding (serving/spec.py): draft length per
-    # verify pass; 0 disables.  Greedy-only — a dispatch with any sampled
-    # lane falls back to the fused scan program.  Decode throughput rises
-    # toward (spec_k+1)x when outputs quote their context (the diagnosis
+    # verify pass; 0 disables.  Every sampling mode speculates — greedy by
+    # argmax match (bit-identical), sampled (incl. top-k/top-p) by the
+    # distribution-exact delta-draft rule.  Decode throughput rises toward
+    # (spec_k+1)x when outputs quote their context (the diagnosis
     # workload: answers cite pod names / events / metric lines verbatim)
     # because a verify pass costs the same weight traffic as one decode
     # step.  Tradeoff: emission per call is data-dependent, so spec
@@ -887,7 +888,8 @@ class InferenceEngine:
         self._decode_cache[key] = prog
         return prog
 
-    def _spec_program(self, k: int, rounds: int, sampled: bool):
+    def _spec_program(self, k: int, rounds: int, sampled: bool,
+                      filtered: bool = False):
         """Build (and cache) the fused speculative-decode program.
 
         Each scanned round, entirely on device: write the current token into
@@ -900,15 +902,14 @@ class InferenceEngine:
 
         ``sampled=False``: argmax acceptance, bit-identical to the
         sequential greedy path.  ``sampled=True``: the delta-draft
-        speculative-sampling rule (spec.accept_sampled), distribution-exact
-        for pure-temperature lanes and handling greedy lanes in the same
-        call; requires every lane to have top-k/top-p disabled (the
-        dispatcher guarantees it).
+        speculative-sampling rule (spec.accept_sampled) against the same
+        temperature/top-k/top-p-filtered distribution sequential decode
+        samples from, with greedy lanes handled in the same call.
 
         Returns (toks [rounds*(k+1), B] with -1 padding, tok_state, pages,
         hist, stats [2] = [verify rounds run, lane-rounds run]).
         """
-        key = ("spec", k, rounds, sampled)
+        key = ("spec", k, rounds, sampled, filtered)
         prog = self._decode_cache.get(key)
         if prog is not None:
             return prog
@@ -917,7 +918,7 @@ class InferenceEngine:
         H = self._hist.shape[1]
 
         def fn(params, tok_state, ctx, quota, pages, tables, hist, temp,
-               rng, eos):
+               topk, topp, rng, eos):
             active0 = ctx > 0
             B = tok_state.shape[0]
             lane = jnp.arange(B, dtype=jnp.int32)
@@ -937,8 +938,13 @@ class InferenceEngine:
                     attn_impl=self._verify_impl)
                 if sampled:
                     rng, sub = jax.random.split(rng)
+                    # `filtered` is a static program property: batches with
+                    # no top-k/top-p lane skip the full-vocab rank sort
+                    # inside accept_sampled (plain softmax, same dist).
                     emit, out = accept_sampled(
-                        sub, logits, drafts, quota, act, eos, temp)
+                        sub, logits, drafts, quota, act, eos, temp,
+                        top_k=topk if filtered else None,
+                        top_p=topp if filtered else None)
                 else:
                     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                     emit, out = accept_greedy(greedy, drafts, quota, act, eos)
@@ -1015,15 +1021,10 @@ class InferenceEngine:
             if not lanes:
                 return False
 
-        def _spec_ok(s: _Slot) -> bool:
-            # Greedy always; sampled lanes only when pure-temperature —
-            # the delta-draft acceptance rule is exact for the plain
-            # softmax distribution, and top-k/top-p reshape it.
-            sp = s.req.sampling
-            return (sp.temperature <= 0.0
-                    or (sp.top_k <= 0 and sp.top_p >= 1.0))
-
-        spec = ec.spec_k > 0 and all(_spec_ok(s) for _, s in lanes)
+        # Every sampling mode speculates: greedy by argmax match, sampled
+        # by the delta-draft rule against the same filtered distribution
+        # sequential decode samples from (spec.accept_sampled).
+        spec = ec.spec_k > 0
         if spec:
             # Emission per spec call is data-dependent (1..k+1 per round),
             # so a dispatch-ahead call would run with an overestimated ctx
@@ -1104,13 +1105,18 @@ class InferenceEngine:
         eos = jnp.asarray(self.eos_id, jnp.int32)
         all_greedy = all(s.req.sampling.temperature <= 0.0 for _, s in lanes)
         if spec:
+            any_filtered = any(
+                s.req.sampling.top_k > 0 or s.req.sampling.top_p < 1.0
+                for _, s in lanes)
             prog = self._spec_program(ec.spec_k, ec.spec_rounds_per_iter,
-                                      sampled=not all_greedy)
+                                      sampled=not all_greedy,
+                                      filtered=any_filtered)
             self._rng, sub = jax.random.split(self._rng)
             toks, self._tok_state, self.pages, self._hist, nver = prog(
                 self.params, self._tok_state, jnp.asarray(ctx),
                 jnp.asarray(steps_arr), self.pages, jnp.asarray(table),
-                self._hist, jnp.asarray(temp), sub, eos,
+                self._hist, jnp.asarray(temp), jnp.asarray(topk),
+                jnp.asarray(topp), sub, eos,
             )
             payload: Any = (toks, nver)
             kind = "spec"

@@ -4,12 +4,15 @@ Two acceptance rules live here:
 
   * ``accept_greedy`` — argmax verification.  Bit-identical to sequential
     greedy decode for ANY draft (see below).
-  * ``accept_sampled`` — exact speculative *sampling* for pure-temperature
-    lanes (top-k/top-p off — the diagnosis default is temperature 0.1 with
-    both filters disabled).  A prompt-lookup draft is a delta distribution
+  * ``accept_sampled`` — exact speculative *sampling* for every sampled
+    mode: the target p is the temperature-scaled, top-k/top-p-filtered,
+    renormalized distribution sequential decode samples from (the shared
+    ``ops/sampling.py:filtered_scaled_logits`` definition; plain softmax
+    when no lane filters).  A prompt-lookup draft is a delta distribution
     q = 1{x}, so the canonical accept rule min(1, p(x)/q(x)) reduces to
     "accept x with probability p(x)", and the rejection residual
-    norm((p-q)+) reduces to p with x zeroed, renormalized.  Marginal check:
+    norm((p-q)+) reduces to p with x zeroed, renormalized — for ANY
+    target p, filtered or not.  Marginal check:
     P(t) = p(x)·1{t=x} + (1-p(x))·p(t)/(1-p(x))·1{t≠x} = p(t) — the output
     distribution is exactly the target's at every position, so sampled
     speculation changes the rng *stream* but not the statistics.
@@ -157,11 +160,19 @@ def accept_sampled(
     active: jnp.ndarray,
     eos_id: jnp.ndarray,
     temperature: jnp.ndarray,
+    top_k: jnp.ndarray | None = None,
+    top_p: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
-    """Distribution-exact acceptance for pure-temperature lanes (see module
+    """Distribution-exact acceptance for sampled lanes (see module
     docstring for the delta-draft derivation), with greedy lanes
     (temperature <= 0) handled by the argmax rule in the same call so one
     program serves a mixed batch.
+
+    The target distribution per position is EXACTLY the one sequential
+    decode samples from — temperature-scaled, top-k/top-p-filtered,
+    renormalized (ops/sampling.py:filtered_scaled_logits, the shared
+    definition) — and the delta-draft accept/residual rule is exact for
+    any target, so nucleus/top-k lanes speculate too.
 
     Args:
       rng: PRNG key (two subkeys consumed per call).
@@ -170,10 +181,13 @@ def accept_sampled(
       drafts: [B, K] int32 proposed tokens fed at verify positions 1..K.
       quota / active / eos_id: as in ``accept_greedy``.
       temperature: [B] float; <= 0 selects the greedy rule for that lane.
+      top_k / top_p: [B] per-lane filters (None = disabled).
 
     Returns:
       (emit [B] int32, out [B, K+1] int32 emitted tokens, -1 padding).
     """
+    from k8s_llm_monitor_tpu.ops.sampling import filtered_scaled_logits
+
     B, K1, V = logits.shape
     K = K1 - 1
     iot = jnp.arange(K1, dtype=jnp.int32)[None, :]
@@ -181,8 +195,22 @@ def accept_sampled(
     greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)       # [B, K+1]
     is_greedy = temperature <= 0.0                               # [B]
 
-    temp = jnp.maximum(temperature, 1e-6)[:, None, None]
-    p = jax.nn.softmax(logits / temp, axis=-1)                   # [B, K+1, V]
+    if top_k is None and top_p is None:
+        # No filtered lane in the batch (the diagnosis default): skip the
+        # full-vocab argsort the rank-cutoff filters need — a plain
+        # temperature softmax is the same distribution with k=V, p=1.
+        temp3 = jnp.maximum(temperature, 1e-6)[:, None, None]
+        p = jax.nn.softmax(logits / temp3, axis=-1)
+    else:
+        if top_k is None:
+            top_k = jnp.zeros((B,), jnp.int32)
+        if top_p is None:
+            top_p = jnp.ones((B,), jnp.float32)
+        rep = lambda a: jnp.repeat(a, K1, axis=0)
+        filtered = filtered_scaled_logits(
+            logits.reshape(B * K1, V), temperature=rep(temperature),
+            top_k=rep(top_k), top_p=rep(top_p))
+        p = jax.nn.softmax(filtered, axis=-1).reshape(B, K1, V)
 
     # Accept draft_i with probability p_i(draft_i) (delta-draft rule);
     # greedy lanes accept on argmax match.

@@ -203,18 +203,51 @@ def test_spec_sampled_engine_completes(params):
             "sampled speculation emitted an out-of-vocab token"
 
 
-def test_spec_topp_lane_falls_back(params):
-    """A top-p lane is not spec-eligible (the filtered distribution breaks
-    the delta-draft rule); the dispatch must use the fused path instead."""
+def test_spec_topp_topk_lanes_speculate(params):
+    """Nucleus/top-k lanes speculate too: acceptance runs against the
+    filtered distribution sequential decode samples from."""
     eng = _spec_engine(params, spec_k=4, rounds=4)
     rng = np.random.default_rng(21)
     eng.submit(GenerationRequest(
         "p0", list(rng.integers(3, 300, size=6)),
         SamplingParams(max_tokens=12, temperature=0.8, top_p=0.9)))
+    eng.submit(GenerationRequest(
+        "p1", list(rng.integers(3, 300, size=6)),
+        SamplingParams(max_tokens=12, temperature=0.8, top_k=5)))
     while eng.has_work:
         eng.step()
     assert len(eng.poll("p0").token_ids) == 12
-    assert eng.spec_verify_steps == 0
+    assert len(eng.poll("p1").token_ids) == 12
+    assert eng.spec_verify_steps > 0
+
+
+def test_accept_sampled_topk_marginal():
+    """With top_k=2 the emitted-token marginal must equal the renormalized
+    top-2 distribution (zero mass outside the filter, exact inside)."""
+    V = 6
+    logits_row = np.array([2.0, 0.5, 1.0, -1.0, 0.0, 1.5], np.float32)
+    temp = 0.9
+    scaled = logits_row / temp
+    top2 = np.argsort(-scaled)[:2]
+    p_ref = np.zeros(V)
+    ex = np.exp(scaled[top2] - scaled[top2].max())
+    p_ref[top2] = ex / ex.sum()
+    logits = jnp.asarray(np.tile(logits_row, (1, 3, 1)))
+    drafts = jnp.asarray([[int(top2[1]), 1]], jnp.int32)
+    N = 4000
+    keys = jax.random.split(jax.random.PRNGKey(1), N)
+    _, outs = jax.vmap(lambda k: accept_sampled(
+        k, logits, drafts,
+        jnp.asarray([64], jnp.int32), jnp.asarray([True]),
+        jnp.asarray(-1, jnp.int32), jnp.asarray([temp], jnp.float32),
+        top_k=jnp.asarray([2], jnp.int32),
+        top_p=jnp.asarray([1.0], jnp.float32)))(keys)
+    first = np.asarray(outs)[:, 0, 0]
+    freq = np.bincount(first, minlength=V) / N
+    np.testing.assert_allclose(freq, p_ref, atol=4.0 / np.sqrt(N),
+                               err_msg=f"filtered marginal {freq} != {p_ref}")
+    # Nothing outside the top-2 filter is ever emitted at position 0.
+    assert freq[[i for i in range(V) if i not in top2]].sum() == 0.0
 
 
 # ---------------------------------------------------------------------------
@@ -344,7 +377,7 @@ def test_spec_mixed_greedy_and_sampled_lanes(params):
         res = eng.poll(f"r{j}")
         assert res is not None and len(res.token_ids) == 10
     assert eng.spec_verify_steps > 0   # pure-temp mix is spec-eligible
-    # Now add a nucleus lane: batch is no longer eligible, fused path runs.
+    # Nucleus lanes speculate too (filtered-distribution acceptance).
     before = eng.spec_verify_steps
     for j in range(2):
         eng.submit(GenerationRequest(
@@ -355,7 +388,7 @@ def test_spec_mixed_greedy_and_sampled_lanes(params):
         eng.step()
     for j in range(2):
         assert len(eng.poll(f"n{j}").token_ids) == 10
-    assert eng.spec_verify_steps == before
+    assert eng.spec_verify_steps > before
 
 
 def test_spec_inflight_then_sampled_admission(params):
@@ -377,8 +410,9 @@ def test_spec_inflight_then_sampled_admission(params):
             break
     assert any(c.kind == "spec" for c in eng._inflight), \
         "test setup: no spec call went in flight"
-    # top_p makes the lane spec-INeligible, flipping dispatch to the fused
-    # path while the spec call is still unreconciled.
+    # A sampled (nucleus) admission flips the batch from the greedy spec
+    # program to the sampled one mid-flight; greedy lanes must stay
+    # bit-exact through the transition (argmax rule inside accept_sampled).
     eng.submit(GenerationRequest(
         "s0", list(rng.integers(3, 300, size=5)),
         SamplingParams(max_tokens=8, temperature=0.9, top_p=0.9)))
