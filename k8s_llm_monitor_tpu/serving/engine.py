@@ -1105,12 +1105,17 @@ class InferenceEngine:
         eos = jnp.asarray(self.eos_id, jnp.int32)
         all_greedy = all(s.req.sampling.temperature <= 0.0 for _, s in lanes)
         if spec:
+            # Filters only matter on lanes that actually sample: a greedy
+            # lane carrying top_p (a common client default) must not force
+            # the filtered program variant (extra compile + per-round
+            # full-vocab sorts the argmax rule never reads).
             any_filtered = any(
-                s.req.sampling.top_k > 0 or s.req.sampling.top_p < 1.0
+                s.req.sampling.temperature > 0.0
+                and (s.req.sampling.top_k > 0 or s.req.sampling.top_p < 1.0)
                 for _, s in lanes)
             prog = self._spec_program(ec.spec_k, ec.spec_rounds_per_iter,
                                       sampled=not all_greedy,
-                                      filtered=any_filtered)
+                                      filtered=any_filtered and not all_greedy)
             self._rng, sub = jax.random.split(self._rng)
             toks, self._tok_state, self.pages, self._hist, nver = prog(
                 self.params, self._tok_state, jnp.asarray(ctx),

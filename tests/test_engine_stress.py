@@ -94,14 +94,16 @@ def test_stress_mixed_workload_under_pressure(params, spec_k):
     assert not eng._inflight
 
 
-def test_stress_cancel_storm(params):
+@pytest.mark.parametrize("spec_k", [0, 4])
+def test_stress_cancel_storm(params, spec_k):
     """Cancel every request at staggered points; pool must fully recover and
     the engine must stay usable afterwards."""
     eng = InferenceEngine(
         CFG, params,
         EngineConfig(max_slots=4, num_blocks=64, block_size=8,
                      max_blocks_per_seq=16, prefill_buckets=(16,),
-                     decode_steps_per_iter=4, max_inflight=2),
+                     decode_steps_per_iter=4, max_inflight=2,
+                     spec_k=spec_k, spec_rounds_per_iter=2),
         eos_id=-1,
     )
     rng = np.random.default_rng(1)
@@ -134,7 +136,8 @@ def test_stress_cancel_storm(params):
     assert r.finish_reason == "length" and len(r.token_ids) == 5
 
 
-def test_stress_waves_of_submissions(params):
+@pytest.mark.parametrize("spec_k", [0, 4])
+def test_stress_waves_of_submissions(params, spec_k):
     """Interleave submission waves with stepping so admission, retirement,
     and slot reuse all overlap in-flight decode calls."""
     eng = InferenceEngine(
@@ -142,7 +145,7 @@ def test_stress_waves_of_submissions(params):
         EngineConfig(max_slots=3, num_blocks=48, block_size=4,
                      max_blocks_per_seq=12, prefill_buckets=(16,),
                      max_prefills_per_step=2, decode_steps_per_iter=2,
-                     max_inflight=2),
+                     max_inflight=2, spec_k=spec_k, spec_rounds_per_iter=2),
         eos_id=-1,
     )
     rng = np.random.default_rng(2)
@@ -172,7 +175,8 @@ def test_stress_waves_of_submissions(params):
     assert eng.allocator.free_blocks == 48 - 1
 
 
-def test_stress_long_prompts_shared_prefixes_and_cancels(params):
+@pytest.mark.parametrize("spec_k", [0, 4])
+def test_stress_long_prompts_shared_prefixes_and_cancels(params, spec_k):
     """The round-4 machinery under randomized load: streaming chunked long
     prompts, prefix-cache hits at every length, cache eviction under a
     tiny pool, preemption, and cancels — must drain without deadlock,
@@ -183,7 +187,8 @@ def test_stress_long_prompts_shared_prefixes_and_cancels(params):
                      max_blocks_per_seq=32, prefill_buckets=(8, 16),
                      max_prefills_per_step=4, max_admission_rounds=2,
                      decode_steps_per_iter=4, max_inflight=2,
-                     decode_every_n_chunk_rounds=2),
+                     decode_every_n_chunk_rounds=2,
+                     spec_k=spec_k, spec_rounds_per_iter=2),
         eos_id=7,
     )
     rng = np.random.default_rng(11)
