@@ -59,6 +59,51 @@ class ModelConfig:
     # Per-expert token capacity = ceil(tokens * top_k * capacity_factor /
     # num_experts); overflow tokens skip the MLP (residual passes through).
     capacity_factor: float = 1.25
+    # --- Gemma-2 family knobs (defaults = Llama conventions) -----------
+    # MLP activation: "silu" (SwiGLU) or "gelu_tanh" (Gemma GeGLU).
+    mlp_activation: str = "silu"
+    # Sandwich norms: extra RMSNorm on the attention and MLP OUTPUTS
+    # (post_attn_norm / post_mlp_norm) before the residual add; the
+    # existing post_norm plays Gemma's pre_feedforward role.
+    sandwich_norms: bool = False
+    # Gemma RMSNorm convention: stored weight is a zero-centered delta,
+    # effective scale = 1 + w (ops/norms.py unit_offset).
+    rmsnorm_unit_offset: bool = False
+    # tanh soft caps (0 = off): attention logits and final lm logits.
+    attn_logit_softcap: float = 0.0
+    final_logit_softcap: float = 0.0
+    # Query scale = query_pre_attn_scalar**-0.5 (None = head_dim**-0.5).
+    query_pre_attn_scalar: Optional[float] = None
+    # Multiply embeddings by sqrt(hidden_size) (Gemma).
+    embed_scale: bool = False
+    # Sliding-window attention: window size (0 = global) and the per-layer
+    # pattern ("sliding_attention"/"full_attention" per layer; None = all
+    # sliding when sliding_window > 0).
+    sliding_window: int = 0
+    layer_types: Optional[tuple] = None
+
+    @property
+    def attn_scale(self) -> Optional[float]:
+        """Explicit query scale, or None for the default head_dim**-0.5."""
+        if self.query_pre_attn_scalar is not None:
+            return float(self.query_pre_attn_scalar) ** -0.5
+        return None
+
+    def layer_window(self, i: int) -> int:
+        """Sliding-window size for layer ``i`` (0 = global attention)."""
+        if self.sliding_window <= 0:
+            return 0
+        if self.layer_types is not None:
+            return (self.sliding_window
+                    if self.layer_types[i] == "sliding_attention" else 0)
+        return self.sliding_window
+
+    @property
+    def has_attn_extras(self) -> bool:
+        """True when attention needs non-Llama parameters threaded (forces
+        the gather attention impls — ops/attention.py selection gates)."""
+        return bool(self.attn_logit_softcap or self.sliding_window
+                    or self.query_pre_attn_scalar is not None)
 
     @property
     def head_dim_(self) -> int:
@@ -117,6 +162,39 @@ MIXTRAL_8X7B = ModelConfig(
     num_experts=8,
     num_experts_per_tok=2,
 )
+
+def _gemma2(name: str, *, hidden: int, inter: int, layers: int, heads: int,
+            kv: int, qpas: float) -> ModelConfig:
+    return ModelConfig(
+        name=name,
+        vocab_size=256_000,
+        hidden_size=hidden,
+        intermediate_size=inter,
+        num_layers=layers,
+        num_heads=heads,
+        num_kv_heads=kv,
+        head_dim=256,
+        rope_theta=10_000.0,
+        rms_norm_eps=1e-6,
+        max_seq_len=8192,
+        tie_embeddings=True,
+        mlp_activation="gelu_tanh",
+        sandwich_norms=True,
+        rmsnorm_unit_offset=True,
+        attn_logit_softcap=50.0,
+        final_logit_softcap=30.0,
+        query_pre_attn_scalar=qpas,
+        embed_scale=True,
+        sliding_window=4096,
+        layer_types=tuple("sliding_attention" if i % 2 == 0
+                          else "full_attention" for i in range(layers)),
+    )
+
+
+GEMMA2_2B = _gemma2("gemma2-2b", hidden=2304, inter=9216, layers=26,
+                    heads=8, kv=4, qpas=256.0)
+GEMMA2_9B = _gemma2("gemma2-9b", hidden=3584, inter=14_336, layers=42,
+                    heads=16, kv=8, qpas=256.0)
 
 # Mistral-7B (v0.3+: no sliding window, full GQA) — same skeleton as
 # Llama-3 with 32k vocab and theta 1e6; loads from HF safetensors via the
@@ -177,7 +255,8 @@ LLAMA_1B = ModelConfig(
 PRESETS = {
     c.name: c
     for c in [TINY, TINY_QWEN, TINY_MOE, LLAMA3_8B, LLAMA3_70B, MISTRAL_7B,
-              MIXTRAL_8X7B, QWEN2_7B, QWEN2_72B, LLAMA_1B]
+              MIXTRAL_8X7B, QWEN2_7B, QWEN2_72B, GEMMA2_2B, GEMMA2_9B,
+              LLAMA_1B]
 }
 
 

@@ -103,18 +103,25 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
             key, (cfg.num_experts, in_f, out_f), jnp.float32) * (in_f ** -0.5)
         return {"kernel": w.astype(dtype)}
 
+    # Gemma stores norm weights as zero-centered deltas (effective scale
+    # 1 + w), so identity-init is zeros there, ones elsewhere.
+    norm_init = jnp.zeros if cfg.rmsnorm_unit_offset else jnp.ones
+
     keys = jax.random.split(rng, 2 + cfg.num_layers)
     layers = []
     for i in range(cfg.num_layers):
         lk = jax.random.split(keys[2 + i], 8)
         layer = {
-            "input_norm": jnp.ones((H,), dtype),
-            "post_norm": jnp.ones((H,), dtype),
+            "input_norm": norm_init((H,), dtype),
+            "post_norm": norm_init((H,), dtype),
             "q": dense(lk[0], H, nH * D, cfg.qkv_bias),
             "k": dense(lk[1], H, nKV * D, cfg.qkv_bias),
             "v": dense(lk[2], H, nKV * D, cfg.qkv_bias),
             "o": dense(lk[3], nH * D, H, False),
         }
+        if cfg.sandwich_norms:
+            layer["post_attn_norm"] = norm_init((H,), dtype)
+            layer["post_mlp_norm"] = norm_init((H,), dtype)
         if cfg.num_experts > 0:
             layer["router"] = dense(lk[7], H, cfg.num_experts, False)
             layer["gate_e"] = expert_dense(lk[4], H, I)
@@ -132,7 +139,7 @@ def init_params(rng: jax.Array, cfg: ModelConfig) -> Params:
             ).astype(dtype)
         },
         "layers": layers,
-        "final_norm": jnp.ones((H,), dtype),
+        "final_norm": norm_init((H,), dtype),
     }
     if not cfg.tie_embeddings:
         params["lm_head"] = dense(keys[1], H, cfg.vocab_size, False)
@@ -199,8 +206,12 @@ def _embed_lookup(params: Params, cfg: ModelConfig,
     dtype = jnp.dtype(cfg.dtype)
     if "weight_q" in emb:
         rows = emb["weight_q"][tokens].astype(dtype)
-        return rows * emb["scale"][tokens][..., None].astype(dtype)
-    return emb["weight"][tokens]
+        rows = rows * emb["scale"][tokens][..., None].astype(dtype)
+    else:
+        rows = emb["weight"][tokens]
+    if cfg.embed_scale:   # Gemma: sqrt(H) normalizer, rounded to dtype
+        rows = rows * jnp.asarray(cfg.hidden_size ** 0.5, rows.dtype)
+    return rows
 
 
 def _qkv(layer: Params, cfg: ModelConfig, x: jnp.ndarray, cos, sin):
@@ -399,17 +410,56 @@ def _moe_mlp_dropless(layer: Params, cfg: ModelConfig,
     return jnp.einsum("ebsh,bse->bsh", ys, w.astype(x.dtype))
 
 
+def _mlp_act(cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
+    if cfg.mlp_activation == "gelu_tanh":      # Gemma GeGLU
+        return jax.nn.gelu(x, approximate=True)
+    return jax.nn.silu(x)
+
+
 def _mlp(layer: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
     if cfg.num_experts > 0:
         return _moe_mlp_dropless(layer, cfg, x)
     aq = cfg.act_quant
     gate = _linear(layer["gate"], x, aq)
     up = _linear(layer["up"], x, aq)
-    return _linear(layer["down"], jax.nn.silu(gate) * up, aq)
+    return _linear(layer["down"], _mlp_act(cfg, gate) * up, aq)
+
+
+def _residual_tail(layer: Params, cfg: ModelConfig, x: jnp.ndarray,
+                   o: jnp.ndarray, collect_aux: bool = False
+                   ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Everything after the attention output projection: the (optionally
+    sandwich-normed) attention residual, the pre-MLP norm, the MLP (or MoE
+    path), and the MLP residual.  The ONE definition shared by
+    layer_block, _prefill_impl, and decode_step so the serving loops can
+    never drift from the dense reference.  Returns (x, aux)."""
+    uo = cfg.rmsnorm_unit_offset
+    if cfg.sandwich_norms:
+        o = rms_norm(o, layer["post_attn_norm"], cfg.rms_norm_eps, uo)
+    x = x + o
+    h = rms_norm(x, layer["post_norm"], cfg.rms_norm_eps, uo)
+    if cfg.num_experts > 0 and collect_aux:
+        y, aux = _moe_mlp(layer, cfg, h)
+    else:
+        y, aux = _mlp(layer, cfg, h), jnp.zeros((), jnp.float32)
+    if cfg.sandwich_norms:
+        y = rms_norm(y, layer["post_mlp_norm"], cfg.rms_norm_eps, uo)
+    return x + y, aux
+
+
+def _attn_extras(cfg: ModelConfig, layer_idx: int) -> dict:
+    """Per-layer attention kwargs for Gemma-style models; {} for the Llama
+    conventions (so stub/custom attention impls never see surprises)."""
+    if not cfg.has_attn_extras:
+        return {}
+    return {"scale": cfg.attn_scale,
+            "logit_softcap": cfg.attn_logit_softcap,
+            "window": cfg.layer_window(layer_idx)}
 
 
 def _unembed(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
-    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps)
+    x = rms_norm(x, params["final_norm"], cfg.rms_norm_eps,
+                 cfg.rmsnorm_unit_offset)
     if cfg.tie_embeddings:
         emb = params["embed"]
         if "weight_q" in emb:
@@ -423,7 +473,11 @@ def _unembed(params: Params, cfg: ModelConfig, x: jnp.ndarray) -> jnp.ndarray:
         # (and the tied-embeddings path is weight-only too) — standard
         # W8A8 practice excludes the head.
         logits = _linear(params["lm_head"], x)
-    return logits.astype(jnp.float32)
+    logits = logits.astype(jnp.float32)
+    if cfg.final_logit_softcap:
+        logits = cfg.final_logit_softcap * jnp.tanh(
+            logits / cfg.final_logit_softcap)
+    return logits
 
 
 # ---------------------------------------------------------------------------
@@ -440,6 +494,7 @@ def layer_block(
     positions: jnp.ndarray,
     attn_fn=None,
     collect_aux: bool = False,
+    layer_idx: int = 0,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """One transformer layer (norm/QKV/attention/residual/MLP) — the single
     definition shared by forward_full and the pipeline stage scan
@@ -448,21 +503,20 @@ def layer_block(
 
     ``collect_aux`` selects the TRAINING MoE path (capacity dispatch +
     load-balance aux); otherwise MoE configs run the dropless inference
-    path.  Returns (x, aux scalar — 0.0 unless collecting).
+    path.  ``layer_idx`` feeds the per-layer sliding-window pattern
+    (Gemma-2 alternates local/global).  Returns (x, aux scalar — 0.0
+    unless collecting).
     """
     if attn_fn is None:
         attn_fn = causal_attention
     B, S = x.shape[:2]
-    h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+    h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps,
+                 cfg.rmsnorm_unit_offset)
     q, k, v = _qkv(layer, cfg, h, cos, sin)
-    attn = attn_fn(q, k, v, q_positions=positions)
-    x = x + _linear(layer["o"], attn.reshape(B, S, -1), cfg.act_quant)
-    h = rms_norm(x, layer["post_norm"], cfg.rms_norm_eps)
-    if cfg.num_experts > 0 and collect_aux:
-        y, aux = _moe_mlp(layer, cfg, h)
-    else:
-        y, aux = _mlp(layer, cfg, h), jnp.zeros((), jnp.float32)
-    return x + y, aux
+    attn = attn_fn(q, k, v, q_positions=positions,
+                   **_attn_extras(cfg, layer_idx))
+    o = _linear(layer["o"], attn.reshape(B, S, -1), cfg.act_quant)
+    return _residual_tail(layer, cfg, x, o, collect_aux)
 
 
 def forward_full(
@@ -493,9 +547,10 @@ def forward_full(
     cos, sin = rope_angles(positions, cfg.head_dim_, cfg.rope_theta,
                            scaling=cfg.rope_scaling)
     aux_total = jnp.zeros((), jnp.float32)
-    for layer in params["layers"]:
+    for li, layer in enumerate(params["layers"]):
         x, aux = layer_block(layer, cfg, x, cos, sin, positions,
-                             attn_fn=attn_fn, collect_aux=return_aux)
+                             attn_fn=attn_fn, collect_aux=return_aux,
+                             layer_idx=li)
         aux_total = aux_total + aux
     logits = _unembed(params, cfg, x)
     if return_aux:
@@ -579,9 +634,10 @@ def _prefill_impl(
                            scaling=cfg.rope_scaling)
 
     x = _embed_lookup(params, cfg, tokens)
+    uo = cfg.rmsnorm_unit_offset
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):
-        h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+        h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps, uo)
         q, k, v = _qkv(layer, cfg, h, cos, sin)
         pk = _scatter_pages(pages.k[li], k, block_tables, positions, valid)
         pv = _scatter_pages(pages.v[li], v, block_tables, positions, valid)
@@ -590,7 +646,8 @@ def _prefill_impl(
         if attend_to_pages and paged_attn_fn is not None:
             # Page-streaming path (Pallas verify kernel): queries are
             # contiguous at positions[:, 0] + i, which both verify_step
-            # and prefill_chunk guarantee.
+            # and prefill_chunk guarantee.  (select_verify_impl returns
+            # None for attn-extras models, so no kwargs needed here.)
             attn = paged_attn_fn(q, pk, pv, block_tables,
                                  positions[:, 0], lengths)
         else:
@@ -605,10 +662,10 @@ def _prefill_impl(
             else:
                 kk, vv = k, v
             attn = causal_attention(q, kk, vv, q_positions=positions,
-                                    kv_len=kv_len)
-        x = x + _linear(layer["o"], attn.reshape(B, S, -1), cfg.act_quant)
-        h = rms_norm(x, layer["post_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(layer, cfg, h)
+                                    kv_len=kv_len,
+                                    **_attn_extras(cfg, li))
+        o = _linear(layer["o"], attn.reshape(B, S, -1), cfg.act_quant)
+        x, _ = _residual_tail(layer, cfg, x, o)
 
     if return_all_logits:
         return _unembed(params, cfg, x), KVPages(k=new_k, v=new_v)
@@ -755,19 +812,23 @@ def decode_step(
                            scaling=cfg.rope_scaling)
 
     x = _embed_lookup(params, cfg, tokens)[:, None, :]  # [B, 1, H]
+    uo = cfg.rmsnorm_unit_offset
     new_lens = context_lens + 1
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):
-        h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps)
+        h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps, uo)
         q, k, v = _qkv(layer, cfg, h, cos, sin)
         pk = _scatter_pages(pages.k[li], k, block_tables, positions, active)
         pv = _scatter_pages(pages.v[li], v, block_tables, positions, active)
         new_k.append(pk)
         new_v.append(pv)
-        attn = attn_impl(q, pk, pv, block_tables, new_lens)
-        x = x + _linear(layer["o"], attn.reshape(B, 1, -1), cfg.act_quant)
-        h = rms_norm(x, layer["post_norm"], cfg.rms_norm_eps)
-        x = x + _mlp(layer, cfg, h)
+        # Extras models are guaranteed the gather impl (select_attn_impl),
+        # which accepts the per-layer kwargs; default models pass none so
+        # custom/Pallas impls keep their fixed signature.
+        attn = attn_impl(q, pk, pv, block_tables, new_lens,
+                         **_attn_extras(cfg, li))
+        o = _linear(layer["o"], attn.reshape(B, 1, -1), cfg.act_quant)
+        x, _ = _residual_tail(layer, cfg, x, o)
 
     logits = _unembed(params, cfg, x)[:, 0, :]
     return logits, KVPages(k=new_k, v=new_v)

@@ -39,6 +39,9 @@ def causal_attention(
     *,
     q_positions: jnp.ndarray | None = None,
     kv_len: jnp.ndarray | None = None,
+    scale: float | None = None,
+    logit_softcap: float = 0.0,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Dense causal attention for prefill.
 
@@ -49,6 +52,11 @@ def causal_attention(
         arange(S) + (T - S) (i.e. queries are the last S positions of kv).
       kv_len: [B] valid kv length per sequence (keys at index >= kv_len are
         masked out).  Defaults to T.
+      scale: query scale; defaults to D**-0.5 (Gemma-2 uses
+        query_pre_attn_scalar**-0.5 instead).
+      logit_softcap: tanh soft cap on attention logits (Gemma-2; 0 = off).
+      window: sliding-window size — queries attend only to keys within the
+        last ``window`` positions (0 = global).  Static per call/layer.
 
     Returns:
       [B, S, H, D] in q.dtype.
@@ -60,9 +68,12 @@ def causal_attention(
     k = _repeat_kv(k, q_per_kv)
     v = _repeat_kv(v, q_per_kv)
 
-    scale = 1.0 / (D ** 0.5)
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
     logits = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32), k.astype(jnp.float32))
     logits *= scale
+    if logit_softcap:
+        logits = logit_softcap * jnp.tanh(logits / logit_softcap)
 
     if q_positions is None:
         q_positions = jnp.arange(S, dtype=jnp.int32)[None, :] + (T - S)
@@ -71,6 +82,9 @@ def causal_attention(
     causal = q_positions[:, :, None] >= kv_positions[None, None, :]  # [B, S, T]
     if kv_len is not None:
         causal = causal & (kv_positions[None, None, :] < kv_len[:, None, None])
+    if window > 0:
+        causal = causal & (kv_positions[None, None, :]
+                           > q_positions[:, :, None] - window)
     logits = jnp.where(causal[:, None, :, :], logits, NEG_INF)
 
     probs = jax.nn.softmax(logits, axis=-1)
@@ -83,6 +97,10 @@ def decode_attention(
     k_cache: jnp.ndarray,
     v_cache: jnp.ndarray,
     lengths: jnp.ndarray,
+    *,
+    scale: float | None = None,
+    logit_softcap: float = 0.0,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Single-token decode against a contiguous KV cache.
 
@@ -91,6 +109,9 @@ def decode_attention(
       k_cache, v_cache: [B, T, KVH, D].
       lengths: [B] int32 — number of valid KV entries per sequence (the new
         token's K/V must already be written at index lengths-1).
+      scale / logit_softcap / window: as in ``causal_attention`` (the
+      query position is lengths-1, so the window keeps keys in
+      ``(lengths-1-window, lengths)``).
     """
     B, _, H, D = q.shape
     T, KVH = k_cache.shape[1], k_cache.shape[2]
@@ -99,10 +120,16 @@ def decode_attention(
     k = _repeat_kv(k_cache, q_per_kv)
     v = _repeat_kv(v_cache, q_per_kv)
 
-    scale = 1.0 / (D ** 0.5)
+    if scale is None:
+        scale = 1.0 / (D ** 0.5)
     logits = jnp.einsum("bshd,bthd->bhst", q.astype(jnp.float32), k.astype(jnp.float32))
     logits *= scale
-    valid = jnp.arange(T, dtype=jnp.int32)[None, :] < lengths[:, None]  # [B, T]
+    if logit_softcap:
+        logits = logit_softcap * jnp.tanh(logits / logit_softcap)
+    kv_positions = jnp.arange(T, dtype=jnp.int32)[None, :]
+    valid = kv_positions < lengths[:, None]                          # [B, T]
+    if window > 0:
+        valid = valid & (kv_positions > (lengths - 1)[:, None] - window)
     logits = jnp.where(valid[:, None, None, :], logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1)
     out = jnp.einsum("bhst,bthd->bshd", probs, v.astype(jnp.float32))
@@ -136,6 +163,10 @@ def paged_decode_attention(
     v_pages: jnp.ndarray,
     block_table: jnp.ndarray,
     lengths: jnp.ndarray,
+    *,
+    scale: float | None = None,
+    logit_softcap: float = 0.0,
+    window: int = 0,
 ) -> jnp.ndarray:
     """Single-token decode against a paged (block) KV cache — XLA reference.
 
@@ -143,13 +174,15 @@ def paged_decode_attention(
     view then runs masked decode attention (unfusing F -> [KVH, D] on the
     gathered activation only).  The Pallas kernel avoids the gather by
     streaming pages HBM->VMEM per block; this version is the semantics
-    reference and the CPU fallback.
+    reference, the CPU fallback, and the only impl carrying the Gemma-2
+    extras (custom scale / logit softcap / sliding window).
     """
     B = q.shape[0]
     D = q.shape[-1]
     k = gather_pages(k_pages, block_table).reshape(B, -1, k_pages.shape[2] // D, D)
     v = gather_pages(v_pages, block_table).reshape(B, -1, v_pages.shape[2] // D, D)
-    return decode_attention(q, k, v, lengths)
+    return decode_attention(q, k, v, lengths, scale=scale,
+                            logit_softcap=logit_softcap, window=window)
 
 
 def paged_verify_attention(
@@ -206,6 +239,10 @@ def select_verify_impl(platform: str | None = None, cfg=None, mesh=None,
     logger = logging.getLogger("k8s_llm_monitor_tpu.ops")
     if platform is None:
         platform = jax.default_backend()
+    if cfg is not None and getattr(cfg, "has_attn_extras", False):
+        # Extras models use _prefill_impl's own gather branch, which
+        # threads the per-layer parameters (models/llama.py).
+        return None
     if mesh is not None or platform != "tpu":
         return paged_verify_attention
     if (max_table_tokens is not None
@@ -294,6 +331,13 @@ def select_attn_impl(platform: str | None = None, cfg=None, mesh=None):
     logger = logging.getLogger("k8s_llm_monitor_tpu.ops")
     if platform is None:
         platform = jax.default_backend()
+
+    if cfg is not None and getattr(cfg, "has_attn_extras", False):
+        # Gemma-2-style extras (query scale / softcap / sliding window)
+        # live only in the gather reference; the Pallas kernel has no
+        # cap/window support (Gemma's head_dim=256 fails its geometry
+        # gate anyway).
+        return paged_decode_attention
 
     if mesh is not None:
         tp = mesh.shape.get("model", 1)

@@ -10,16 +10,22 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 
-def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5) -> jnp.ndarray:
+def rms_norm(x: jnp.ndarray, weight: jnp.ndarray, eps: float = 1e-5,
+             unit_offset: bool = False) -> jnp.ndarray:
     """Root-mean-square layer norm (no mean-centering, no bias).
 
-    Llama/Qwen convention: normalize in fp32, scale by ``weight``, cast back.
+    Llama/Qwen convention: normalize in fp32, scale by ``weight``, cast
+    back.  ``unit_offset`` selects the Gemma convention: the stored weight
+    is a zero-centered delta and the effective scale is ``1 + weight``.
     """
     dtype = x.dtype
     x32 = x.astype(jnp.float32)
     var = jnp.mean(x32 * x32, axis=-1, keepdims=True)
     x32 = x32 * (1.0 / jnp.sqrt(var + eps))
-    return (x32 * weight.astype(jnp.float32)).astype(dtype)
+    w32 = weight.astype(jnp.float32)
+    if unit_offset:
+        w32 = 1.0 + w32
+    return (x32 * w32).astype(dtype)
 
 
 def layer_norm(

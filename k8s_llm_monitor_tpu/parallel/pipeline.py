@@ -168,6 +168,12 @@ def make_pipeline_forward(mesh: Mesh, cfg: ModelConfig):
             "pipeline parallelism does not thread the MoE load-balancing "
             "aux loss yet — train MoE configs on the GSPMD data x model "
             "mesh (expert parallelism, training/train.py) instead")
+    if cfg.sliding_window > 0:
+        raise NotImplementedError(
+            "pipeline parallelism scans a stage's layers with one compiled "
+            "body; per-layer sliding-window patterns (Gemma-2) need "
+            "per-layer static masks — train these configs on the GSPMD "
+            "mesh instead")
 
     def fn(staged_layers, x0):
         in_layer_specs = jax.tree.map(

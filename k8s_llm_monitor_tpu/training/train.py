@@ -149,6 +149,11 @@ def make_train_step(
 
     attn_fn = None
     if tc.ring_attention and mesh is not None and mesh.shape["seq"] > 1:
+        if cfg.has_attn_extras:
+            raise ValueError(
+                "ring attention does not support Gemma-style attention "
+                "extras (softcap / sliding window / custom query scale) — "
+                "train these configs without --ring-attention")
         from k8s_llm_monitor_tpu.parallel.ring_attention import (
             make_ring_attention,
         )

@@ -24,8 +24,26 @@ Params = dict[str, Any]
 
 
 def config_from_hf(hf: Mapping[str, Any], name: str = "hf-model") -> ModelConfig:
-    """Translate a HF ``config.json`` dict (LlamaConfig/Qwen2Config) to ours."""
+    """Translate a HF ``config.json`` dict (Llama/Qwen2/Mixtral/Gemma-2
+    configs) to ours.  Keys equal to a HF class default are OMITTED from
+    saved config.json (diff-serialization), so family-specific defaults
+    must be reproduced here, not read with neutral fallbacks."""
     num_heads = hf["num_attention_heads"]
+    gemma2 = hf.get("model_type") == "gemma2"
+    n_layers = hf["num_hidden_layers"]
+    # Sliding windows: Qwen2 ships sliding_window=131072 with
+    # use_sliding_window=false — the raw value alone must not enable
+    # window masking (it would force the gather attention impls and
+    # reject pipeline/ring training for a model that has no windows).
+    sliding = hf.get("sliding_window") or 0
+    if hf.get("use_sliding_window") is False:
+        sliding = 0
+    layer_types = tuple(hf["layer_types"]) if hf.get("layer_types") else None
+    if gemma2 and sliding and layer_types is None:
+        # Gemma-2 configs released before HF serialized layer_types:
+        # the architecture alternates sliding/full starting at layer 0.
+        layer_types = tuple("sliding_attention" if i % 2 == 0
+                            else "full_attention" for i in range(n_layers))
     return ModelConfig(
         name=name,
         vocab_size=hf["vocab_size"],
@@ -40,10 +58,28 @@ def config_from_hf(hf: Mapping[str, Any], name: str = "hf-model") -> ModelConfig
         rms_norm_eps=hf.get("rms_norm_eps", 1e-5),
         max_seq_len=hf.get("max_position_embeddings", 8192),
         qkv_bias=hf.get("model_type") == "qwen2",
-        tie_embeddings=hf.get("tie_word_embeddings", False),
+        # Gemma-2 ties embeddings by CLASS default, so saved configs omit
+        # the key — a neutral False default would demand a lm_head tensor
+        # tied checkpoints don't ship.
+        tie_embeddings=hf.get("tie_word_embeddings", gemma2),
         # Mixtral: MoE geometry from the HF keys (0/absent = dense).
         num_experts=hf.get("num_local_experts", 0),
         num_experts_per_tok=hf.get("num_experts_per_tok", 2),
+        # Gemma-2 conventions (defaults reproduce Llama for other types;
+        # class-default-omitted keys fall back per family).
+        mlp_activation=("gelu_tanh" if gemma2 or hf.get("hidden_activation")
+                        == "gelu_pytorch_tanh" else "silu"),
+        sandwich_norms=gemma2,
+        rmsnorm_unit_offset=gemma2,
+        attn_logit_softcap=hf.get(
+            "attn_logit_softcapping", 50.0 if gemma2 else 0.0) or 0.0,
+        final_logit_softcap=hf.get(
+            "final_logit_softcapping", 30.0 if gemma2 else 0.0) or 0.0,
+        query_pre_attn_scalar=(hf.get("query_pre_attn_scalar", 256.0)
+                               if gemma2 else None),
+        embed_scale=gemma2,
+        sliding_window=sliding,
+        layer_types=layer_types,
     )
 
 
@@ -108,10 +144,21 @@ def convert_hf_state_dict(
     layers = []
     for i in range(cfg.num_layers):
         pre = f"model.layers.{i}."
-        layer: Params = {
-            "input_norm": get(pre + "input_layernorm.weight"),
-            "post_norm": get(pre + "post_attention_layernorm.weight"),
-        }
+        if cfg.sandwich_norms:
+            # Gemma-2: post_attention norm applies to the attention OUTPUT,
+            # pre/post_feedforward sandwich the MLP (our post_norm plays
+            # the pre_feedforward role — models/llama.py:layer_block).
+            layer: Params = {
+                "input_norm": get(pre + "input_layernorm.weight"),
+                "post_attn_norm": get(pre + "post_attention_layernorm.weight"),
+                "post_norm": get(pre + "pre_feedforward_layernorm.weight"),
+                "post_mlp_norm": get(pre + "post_feedforward_layernorm.weight"),
+            }
+        else:
+            layer = {
+                "input_norm": get(pre + "input_layernorm.weight"),
+                "post_norm": get(pre + "post_attention_layernorm.weight"),
+            }
         for ours, theirs in _LINEAR_MAP.items():
             if cfg.num_experts > 0 and ours in ("gate", "up", "down"):
                 continue

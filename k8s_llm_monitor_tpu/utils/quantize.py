@@ -105,6 +105,9 @@ def quantize_params(params: Params) -> Params:
             "input_norm": layer["input_norm"],
             "post_norm": layer["post_norm"],
         }
+        for name in ("post_attn_norm", "post_mlp_norm"):  # Gemma sandwich
+            if name in layer:
+                ql[name] = layer[name]
         for name in ("q", "k", "v", "o"):
             ql[name] = quantize_linear(layer[name])
         if "router" in layer:

@@ -288,3 +288,32 @@ def test_fp8_kv_cache_decode_parity():
     got = decode_logits(cfg8)
     cos = (ref * got).sum() / (np.linalg.norm(ref) * np.linalg.norm(got))
     assert cos > 0.98, cos
+
+
+def test_gemma2_int8_roundtrip():
+    """quantize_params keeps the Gemma sandwich norms and the quantized
+    model still matches its own bf16 logits closely."""
+    import numpy as np
+
+    from k8s_llm_monitor_tpu.models import llama
+    from k8s_llm_monitor_tpu.models.config import ModelConfig
+    from k8s_llm_monitor_tpu.utils.quantize import quantize_params
+
+    cfg = ModelConfig(
+        name="tiny-gemma-q", vocab_size=160, hidden_size=32,
+        intermediate_size=64, num_layers=2, num_heads=4, num_kv_heads=2,
+        head_dim=8, dtype="float32", rope_theta=10_000.0,
+        tie_embeddings=True, mlp_activation="gelu_tanh",
+        sandwich_norms=True, rmsnorm_unit_offset=True,
+        attn_logit_softcap=50.0, final_logit_softcap=30.0,
+        query_pre_attn_scalar=12.0, embed_scale=True)
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    qp = quantize_params(params)
+    assert "post_attn_norm" in qp["layers"][0]
+    assert "post_mlp_norm" in qp["layers"][0]
+    rng = np.random.default_rng(0)
+    toks = jnp.asarray(rng.integers(2, 160, size=(2, 9)), jnp.int32)
+    a = np.asarray(llama.forward_full(params, cfg, toks)).reshape(-1)
+    b = np.asarray(llama.forward_full(qp, cfg, toks)).reshape(-1)
+    cos = float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)))
+    assert cos > 0.995, cos
