@@ -163,6 +163,14 @@ MIXTRAL_8X7B = ModelConfig(
     num_experts_per_tok=2,
 )
 
+def gemma2_layer_types(n_layers: int) -> tuple:
+    """Gemma-2's attention pattern: alternating sliding/full, sliding
+    first.  The ONE definition shared by the presets and the HF-config
+    fallback (utils/checkpoint.py) so they cannot drift."""
+    return tuple("sliding_attention" if i % 2 == 0 else "full_attention"
+                 for i in range(n_layers))
+
+
 def _gemma2(name: str, *, hidden: int, inter: int, layers: int, heads: int,
             kv: int, qpas: float) -> ModelConfig:
     return ModelConfig(
@@ -186,8 +194,7 @@ def _gemma2(name: str, *, hidden: int, inter: int, layers: int, heads: int,
         query_pre_attn_scalar=qpas,
         embed_scale=True,
         sliding_window=4096,
-        layer_types=tuple("sliding_attention" if i % 2 == 0
-                          else "full_attention" for i in range(layers)),
+        layer_types=gemma2_layer_types(layers),
     )
 
 

@@ -145,6 +145,18 @@ class EngineConfig:
     # Verify rounds fused into one spec dispatch (device-side scan) — the
     # host-sync amortization knob, the spec analogue of decode_steps_per_iter.
     spec_rounds_per_iter: int = 4
+    # Adaptive speculation: a spec dispatch serializes the pipeline and a
+    # verify forward costs more than a fused step, so near the acceptance
+    # floor speculation loses to the fused path.  The engine tracks an EMA
+    # of EMITTED tokens per lane-round — accepted drafts plus the one
+    # correction/bonus token, so the metric's floor is 1.0 even with zero
+    # drafts accepted — and falls back to the fused path below this
+    # threshold, re-probing with one spec dispatch every spec_probe_every
+    # decode dispatches in case the workload turned quotable again.  The
+    # default sits below the 1.44 the live diagnosis workload measures
+    # (README) and above the 1.0 floor where fused wins.
+    spec_min_accept: float = 1.2
+    spec_probe_every: int = 32
     # History window for n-gram matching, per lane (tokens; rounded down to
     # the per-seq capacity).  [max_slots, cap] int32 is KBs, not MBs.
     spec_hist_cap: int = 4096
@@ -355,6 +367,11 @@ class InferenceEngine:
         self.spec_tokens = 0         # tokens emitted by spec dispatches
         self.spec_verify_steps = 0   # verify forwards those tokens cost
         self.spec_lane_rounds = 0    # sum of active lanes over those forwards
+        # Adaptive speculation state: EMA of accepted tokens per lane-round
+        # (None = no measurement yet -> speculate optimistically) and the
+        # fused-dispatch count since the last probe.
+        self._spec_ema: Optional[float] = None
+        self._since_spec_probe = 0
 
         self._rng = jax.random.PRNGKey(seed)
         self._tok_state = jnp.zeros((ec.max_slots,), jnp.int32)
@@ -1023,8 +1040,18 @@ class InferenceEngine:
 
         # Every sampling mode speculates: greedy by argmax match, sampled
         # by the delta-draft rule against the same filtered distribution
-        # sequential decode samples from (spec.accept_sampled).
+        # sequential decode samples from (spec.accept_sampled).  Whether a
+        # given dispatch speculates is ADAPTIVE: below the measured
+        # acceptance threshold the fused pipelined path wins, so spec runs
+        # only as a periodic probe until acceptance recovers.
         spec = ec.spec_k > 0
+        if (spec and self._spec_ema is not None
+                and self._spec_ema < ec.spec_min_accept):
+            self._since_spec_probe += 1
+            if self._since_spec_probe < ec.spec_probe_every:
+                spec = False
+            else:
+                self._since_spec_probe = 0
         if spec:
             # Emission per spec call is data-dependent (1..k+1 per round),
             # so a dispatch-ahead call would run with an overestimated ctx
@@ -1166,6 +1193,11 @@ class InferenceEngine:
             self.spec_verify_steps += ran
             self.spec_lane_rounds += lane_rounds
             self.steps += ran
+            if lane_rounds:
+                # Acceptance EMA drives the adaptive spec/fused choice.
+                rate = float(np.sum(arr >= 0)) / lane_rounds
+                self._spec_ema = (rate if self._spec_ema is None
+                                  else 0.8 * self._spec_ema + 0.2 * rate)
         else:
             arr = np.asarray(call.arr)
         if call.kind in ("admit", "chunk"):

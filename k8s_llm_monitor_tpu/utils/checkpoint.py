@@ -35,15 +35,16 @@ def config_from_hf(hf: Mapping[str, Any], name: str = "hf-model") -> ModelConfig
     # use_sliding_window=false — the raw value alone must not enable
     # window masking (it would force the gather attention impls and
     # reject pipeline/ring training for a model that has no windows).
-    sliding = hf.get("sliding_window") or 0
+    # Gemma-2's CLASS default is 4096, omitted by diff-serialization.
+    sliding = hf.get("sliding_window", 4096 if gemma2 else 0) or 0
     if hf.get("use_sliding_window") is False:
         sliding = 0
     layer_types = tuple(hf["layer_types"]) if hf.get("layer_types") else None
     if gemma2 and sliding and layer_types is None:
-        # Gemma-2 configs released before HF serialized layer_types:
-        # the architecture alternates sliding/full starting at layer 0.
-        layer_types = tuple("sliding_attention" if i % 2 == 0
-                            else "full_attention" for i in range(n_layers))
+        # Gemma-2 configs released before HF serialized layer_types.
+        from k8s_llm_monitor_tpu.models.config import gemma2_layer_types
+
+        layer_types = gemma2_layer_types(n_layers)
     return ModelConfig(
         name=name,
         vocab_size=hf["vocab_size"],

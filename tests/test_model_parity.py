@@ -152,6 +152,11 @@ def test_config_from_hf_family_defaults():
     g = config_from_hf({**base, "model_type": "gemma2",
                         "sliding_window": 8}, "g")
     assert g.tie_embeddings
+    # sliding_window itself is a Gemma-2 class default (4096) that
+    # re-saved configs omit — absence must not disable windows.
+    g2 = config_from_hf({**base, "model_type": "gemma2"}, "g2")
+    assert g2.sliding_window == 4096
+    assert g2.layer_types is not None and len(g2.layer_types) == 4
     assert g.attn_logit_softcap == 50.0 and g.final_logit_softcap == 30.0
     assert g.query_pre_attn_scalar == 256.0
     assert g.layer_types == ("sliding_attention", "full_attention") * 2
