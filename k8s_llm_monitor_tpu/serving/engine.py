@@ -153,8 +153,8 @@ class EngineConfig:
     # drafts accepted — and falls back to the fused path below this
     # threshold, re-probing with one spec dispatch every spec_probe_every
     # decode dispatches in case the workload turned quotable again.  The
-    # default sits below the 1.44 the live diagnosis workload measures
-    # (README) and above the 1.0 floor where fused wins.
+    # default sits above the 1.0 floor (where fused wins) with margin for
+    # the verify forward's extra cost over a fused step.
     spec_min_accept: float = 1.2
     spec_probe_every: int = 32
     # History window for n-gram matching, per lane (tokens; rounded down to
@@ -251,12 +251,34 @@ class InferenceEngine:
 
         ec = self.ecfg
         pages = llama.init_kv_pages(cfg, ec.num_blocks, ec.block_size)
+        # Sequence-sharded prefill (SURVEY §7 step 5): on a mesh with a
+        # nontrivial ``seq`` axis, prefill/chunk token batches are placed
+        # sharded over ``seq`` — GSPMD then splits the per-position matmul
+        # FLOPs across the axis (each device embeds/projects its sequence
+        # slice, all-gathers chunk K/V for attention, and the page scatter
+        # reassembles) so ONE long prompt's ingestion spreads over chips,
+        # e.g. mesh_shape "1,2,4" on a v5e-8.  Decode is untouched: its
+        # [B, 1] queries have no sequence axis to split.
+        self._tok_sharding = None
         if mesh is not None:
             from jax.sharding import NamedSharding
             from k8s_llm_monitor_tpu.parallel.sharding import (
                 kv_pages_partition_specs,
                 param_partition_specs,
             )
+
+            seq_deg = mesh.shape.get("seq", 1)
+            if seq_deg > 1:
+                from jax.sharding import PartitionSpec
+
+                for b in ec.prefill_buckets:
+                    if b % seq_deg:
+                        raise ValueError(
+                            f"prefill bucket {b} is not divisible by the "
+                            f"mesh seq axis ({seq_deg}); choose bucket "
+                            f"sizes that split evenly")
+                self._tok_sharding = NamedSharding(
+                    mesh, PartitionSpec(None, "seq"))
 
             pspecs = param_partition_specs(params)
             params = jax.tree.map(
@@ -544,6 +566,25 @@ class InferenceEngine:
     def _free_slots(self) -> list[int]:
         return [i for i, s in enumerate(self._slots) if s is None]
 
+    def _lane_count(self, n: int) -> int:
+        """Smallest power-of-two lane count covering ``n`` (capped at
+        ``max_prefills_per_step``).  Padded lanes cost real FLOPs — a
+        2-candidate round padded to 8 lanes dispatches 4x the needed
+        prefill compute — while the pow-2 ladder keeps the compile cache
+        at log2(max) entries instead of one per batch size."""
+        P = 1
+        while P < n:
+            P <<= 1
+        return min(P, self.ecfg.max_prefills_per_step)
+
+    def _tokens_to_device(self, tokens: np.ndarray):
+        """Token batch -> device, sharded over the mesh ``seq`` axis when
+        sequence-parallel prefill is active (see __init__)."""
+        t = jnp.asarray(tokens)
+        if self._tok_sharding is not None:
+            t = jax.device_put(t, self._tok_sharding)
+        return t
+
     def _fail_request(self, req: GenerationRequest, msg: str) -> None:
         now = time.monotonic()
         result = GenerationResult(
@@ -563,20 +604,37 @@ class InferenceEngine:
         if self.token_sink is not None and toks:
             self.token_sink(req.request_id, toks, None)
 
-    def _lane_buffers(self, P: int, bucket: int):
+    def _lane_buffers(self, P: int, bucket: int, table_width: int = 0):
         """Host-side lane arrays shared by the admission and chunk-round
         dispatch paths: (tokens, start, lengths, tables, idx, temp, topk,
         topp).  ``idx`` defaults to max_slots so padding / non-final lanes
-        scatter their sampled token out of range (dropped)."""
+        scatter their sampled token out of range (dropped).
+
+        ``table_width`` (0 = full ``max_blocks_per_seq``) narrows the block
+        table passed to the chunked program: its paged-attention gather
+        materializes ``table_width * block_size`` keys per lane per layer
+        regardless of real context, so a round early in a long prompt
+        would otherwise pay the full-capacity gather (measured on v5e 8B
+        W8A8: [4,512] chunk rounds run 221 ms at 2048 gathered keys vs
+        171 ms at 1024 — ~25 ms per extra 512 keys)."""
         ec = self.ecfg
+        W = table_width or ec.max_blocks_per_seq
         return (np.zeros((P, bucket), np.int32),
                 np.zeros((P,), np.int32),
                 np.zeros((P,), np.int32),
-                np.zeros((P, ec.max_blocks_per_seq), np.int32),
+                np.zeros((P, W), np.int32),
                 np.full((P,), ec.max_slots, np.int32),
                 np.zeros((P,), np.float32),
                 np.zeros((P,), np.int32),
                 np.ones((P,), np.float32))
+
+    def _table_width(self, max_tokens_covered: int) -> int:
+        """Block-table width bucket for a chunked dispatch: enough blocks
+        for the deepest lane's context, rounded up to 32 blocks so compile
+        variants stay bounded (<= max_blocks_per_seq/32 widths)."""
+        need = (max_tokens_covered + self.ecfg.block_size - 1) \
+            // self.ecfg.block_size
+        return min(self.ecfg.max_blocks_per_seq, (need + 31) // 32 * 32)
 
     def _write_hist(self, entries: list[tuple[int, GenerationRequest]]) -> None:
         """Load prompt tokens into the speculation history rows of freshly
@@ -672,13 +730,18 @@ class InferenceEngine:
         if not batch:
             return admitted_long > 0
 
-        # Fixed lane counts (1 or the max) keep the compile cache small.
-        P = 1 if len(batch) == 1 else ec.max_prefills_per_step
+        P = self._lane_count(len(batch))
         any_shared = any(st > 0 for _, _, _, st in batch)
         bucket = self._bucket(
             max(len(r.prompt_ids) - st for _, r, _, st in batch))
+        # The chunked program (taken when any lane shares a cached prefix)
+        # gathers table_width * block_size keys per lane; narrow it to the
+        # deepest prompt.  The dense program never gathers — full width
+        # there avoids extra compile shapes.
+        W = (self._table_width(max(len(r.prompt_ids) for _, r, _, _ in batch))
+             if any_shared else 0)
         (tokens, start, lengths, tables, idx,
-         temp, topk, topp) = self._lane_buffers(P, bucket)
+         temp, topk, topp) = self._lane_buffers(P, bucket, W)
         for j, (slot_idx, req, blocks, st) in enumerate(batch):
             L = len(req.prompt_ids)
             if req.orig_prompt_len < 0:
@@ -686,7 +749,11 @@ class InferenceEngine:
             tokens[j, : L - st] = req.prompt_ids[st:]
             start[j] = st
             lengths[j] = L - st
-            tables[j, : len(blocks)] = blocks
+            # blocks may cover L+1 tokens (the first decode write); the
+            # prefill only reads/writes positions < L, so truncating to the
+            # narrowed width is safe — decode uses its own full table.
+            nb = min(len(blocks), tables.shape[1])
+            tables[j, :nb] = blocks[:nb]
             idx[j] = slot_idx
             sp = req.sampling
             temp[j], topk[j], topp[j] = sp.temperature, sp.top_k, sp.top_p
@@ -695,26 +762,26 @@ class InferenceEngine:
         if not any_shared:
             if all_greedy:
                 first, self.pages = self._prefill_greedy(
-                    self.params, jnp.asarray(tokens), jnp.asarray(lengths),
+                    self.params, self._tokens_to_device(tokens), jnp.asarray(lengths),
                     self.pages, jnp.asarray(tables),
                 )
             else:
                 self._rng, sub = jax.random.split(self._rng)
                 first, self.pages = self._prefill_sample(
-                    self.params, jnp.asarray(tokens), jnp.asarray(lengths),
+                    self.params, self._tokens_to_device(tokens), jnp.asarray(lengths),
                     self.pages, jnp.asarray(tables), jnp.asarray(temp),
                     jnp.asarray(topk), jnp.asarray(topp), sub,
                 )
         else:
             if all_greedy:
                 first, self.pages = self._prefill_chunk_greedy(
-                    self.params, jnp.asarray(tokens), jnp.asarray(start),
+                    self.params, self._tokens_to_device(tokens), jnp.asarray(start),
                     jnp.asarray(lengths), self.pages, jnp.asarray(tables),
                 )
             else:
                 self._rng, sub = jax.random.split(self._rng)
                 first, self.pages = self._prefill_chunk_sample(
-                    self.params, jnp.asarray(tokens), jnp.asarray(start),
+                    self.params, self._tokens_to_device(tokens), jnp.asarray(start),
                     jnp.asarray(lengths), self.pages, jnp.asarray(tables),
                     jnp.asarray(temp), jnp.asarray(topk),
                     jnp.asarray(topp), sub,
@@ -750,11 +817,17 @@ class InferenceEngine:
                                   t[1].req.submit_time))
         cands = cands[:ec.max_prefills_per_step]
 
-        P = 1 if len(cands) == 1 else ec.max_prefills_per_step
+        P = self._lane_count(len(cands))
         bucket = self._bucket(min(top, max(
             len(s.req.prompt_ids) - s.prefill_pos for _, s in cands)))
+        # Narrow the gathered table to the deepest lane's post-round
+        # context: early rounds of a long prompt attend to a fraction of
+        # capacity, and the gather cost scales with table width.
+        W = self._table_width(max(
+            s.prefill_pos + min(bucket, len(s.req.prompt_ids)
+                                - s.prefill_pos) for _, s in cands))
         (tokens, start, lengths, tables, idx,
-         temp, topk, topp) = self._lane_buffers(P, bucket)
+         temp, topk, topp) = self._lane_buffers(P, bucket, W)
         lanes: list[tuple] = []
         touched: list[_Slot] = []
         final_greedy = True
@@ -764,7 +837,8 @@ class InferenceEngine:
             tokens[j, :n] = s.req.prompt_ids[s.prefill_pos:s.prefill_pos + n]
             start[j] = s.prefill_pos
             lengths[j] = n
-            tables[j, : len(s.blocks)] = s.blocks
+            nb = min(len(s.blocks), tables.shape[1])
+            tables[j, :nb] = s.blocks[:nb]
             s.prefill_pos += n
             s.inflight_chunks += 1
             touched.append(s)
@@ -783,13 +857,13 @@ class InferenceEngine:
 
         if final_greedy:
             first, self.pages = self._prefill_chunk_greedy(
-                self.params, jnp.asarray(tokens), jnp.asarray(start),
+                self.params, self._tokens_to_device(tokens), jnp.asarray(start),
                 jnp.asarray(lengths), self.pages, jnp.asarray(tables),
             )
         else:
             self._rng, sub = jax.random.split(self._rng)
             first, self.pages = self._prefill_chunk_sample(
-                self.params, jnp.asarray(tokens), jnp.asarray(start),
+                self.params, self._tokens_to_device(tokens), jnp.asarray(start),
                 jnp.asarray(lengths), self.pages, jnp.asarray(tables),
                 jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
                 sub,

@@ -70,6 +70,40 @@ def test_tp_engine_generation_matches_unsharded(cpu_mesh_devices):
         assert a.token_ids == b.token_ids
 
 
+def test_seq_sharded_prefill_engine_matches_unsharded(cpu_mesh_devices):
+    """Sequence-parallel serve prefill (SURVEY §7 step 5): a mesh with a
+    nontrivial ``seq`` axis shards chunked-prefill token batches over it
+    (engine._tokens_to_device), splitting one long prompt's ingestion
+    FLOPs across chips.  Long prompts (> top bucket) force the chunk-round
+    path; output must be token-identical to the unsharded engine."""
+    mesh = create_mesh(MeshConfig(data=1, seq=2, model=4))
+    params = llama.init_params(jax.random.PRNGKey(2), CFG)
+    ecfg = EngineConfig(max_slots=2, num_blocks=32, block_size=8,
+                        max_blocks_per_seq=8, prefill_buckets=(16,))
+    rng = np.random.default_rng(3)
+    prompts = [list(rng.integers(2, 500, size=40)),   # 40 > 16: chunked
+               list(rng.integers(2, 500, size=12))]   # dense admission
+    sp = SamplingParams(max_tokens=5)
+
+    plain = InferenceEngine(CFG, params, ecfg, eos_id=-1).generate(prompts, sp)
+    sq = InferenceEngine(CFG, params, ecfg, eos_id=-1, mesh=mesh)
+    assert sq._tok_sharding is not None
+    seq = sq.generate(prompts, sp)
+    for a, b in zip(plain, seq):
+        assert a.token_ids == b.token_ids
+
+
+def test_seq_mesh_rejects_indivisible_buckets(cpu_mesh_devices):
+    import pytest
+
+    mesh = create_mesh(MeshConfig(data=1, seq=2, model=4))
+    params = llama.init_params(jax.random.PRNGKey(2), CFG)
+    ecfg = EngineConfig(max_slots=2, num_blocks=32, block_size=8,
+                        max_blocks_per_seq=8, prefill_buckets=(15,))
+    with pytest.raises(ValueError, match="seq"):
+        InferenceEngine(CFG, params, ecfg, eos_id=-1, mesh=mesh)
+
+
 def test_all_presets_are_coherent_and_tp8_shardable():
     """Every serving preset must have integral GQA/head geometry and a
     parameter pytree whose model-sharded axes divide a TP-8 mesh (or fall
