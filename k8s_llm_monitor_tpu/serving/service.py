@@ -15,6 +15,7 @@ device (streaming seam for SSE in monitor/server.py) and a final
 
 from __future__ import annotations
 
+import atexit
 import itertools
 import queue
 import threading
@@ -110,6 +111,12 @@ class EngineService:
         self._thread = threading.Thread(
             target=self._run, name="engine-service", daemon=True)
         self._thread.start()
+        # Interpreter shutdown kills daemon threads wherever they stand; a
+        # step loop torn down inside an XLA call aborts the whole process
+        # ("FATAL: exception not rethrown").  atexit runs before daemon
+        # teardown, so stop the loop first — hosts that call stop()
+        # themselves just make this a no-op.
+        atexit.register(self.stop)
 
     # -- submission -----------------------------------------------------
 
@@ -159,6 +166,7 @@ class EngineService:
         self._stop.set()
         self._wake.set()
         self._thread.join(timeout=timeout)
+        atexit.unregister(self.stop)
 
     # -- loop -----------------------------------------------------------
 
