@@ -162,16 +162,28 @@ def main() -> None:
     def prompt() -> list[int]:
         return list(rng.integers(4, cfg.vocab_size - 4, size=prompt_len))
 
-    # Warm up every compiled shape — batched (P=max_prefills_per_step) and
-    # single (P=1) prefill, and the fused-decode K ladder the drain will
-    # walk — so the measured run excludes compile time.  With a populated
+    def ttft_pcts(results) -> tuple[float, float]:
+        """(p50, p99) TTFT in ms — every leg reports its tail, not just the
+        headline (a diagnosis product's slowest 1% is a budget, not noise)."""
+        t = np.array(sorted(r.ttft_s for r in results))
+        return (float(np.percentile(t, 50)) * 1e3,
+                float(np.percentile(t, 99)) * 1e3)
+
+    # Warm up every compiled shape — the power-of-two admission-lane ladder
+    # (the engine pads prefill batches up, so a 100-burst walks P=16 rounds
+    # plus a P=4 tail) and the fused-decode K ladder the drain will walk —
+    # so the measured run excludes compile time.  With a populated
     # .jax_cache this is seconds, not minutes.
     log(f"warmup (compiles prefill/decode; cache "
         f"{'warm' if cache_was_warm else 'cold'})...")
     wt0 = time.monotonic()
-    eng.generate([prompt() for _ in range(2)],
+    eng.generate([prompt() for _ in range(ecfg.max_prefills_per_step)],
                  SamplingParams(max_tokens=max_tokens))
-    eng.generate([prompt()], SamplingParams(max_tokens=4))
+    w = ecfg.max_prefills_per_step // 2
+    while w >= 1:
+        eng.generate([prompt() for _ in range(w)],
+                     SamplingParams(max_tokens=4))
+        w //= 2
     warmup_s = time.monotonic() - wt0
     log(f"warmup done in {warmup_s:.1f}s")
 
@@ -206,7 +218,7 @@ def main() -> None:
 
     # --- per-chip-equivalent leg: the SLO's v5e-8 config spread over 8
     # chips is ~12 concurrent per chip; same engine, warm shapes. ---------
-    perchip_p50_ms = None
+    perchip_p50_ms = perchip_p99_ms = None
     try:
         n_pc = max(1, n_requests // 8)
         for i in range(n_pc):
@@ -217,10 +229,9 @@ def main() -> None:
             eng.step()
         pcres = [eng.poll(f"pc-{i}") for i in range(n_pc)]
         assert all(r is not None and r.finish_reason != "error" for r in pcres)
-        perchip_p50_ms = float(np.percentile(
-            np.array(sorted(r.ttft_s for r in pcres)), 50)) * 1e3
+        perchip_p50_ms, perchip_p99_ms = ttft_pcts(pcres)
         log(f"per-chip-equivalent ({n_pc} concurrent): "
-            f"p50 TTFT {perchip_p50_ms:.1f} ms")
+            f"p50 TTFT {perchip_p50_ms:.1f} ms, p99 {perchip_p99_ms:.1f} ms")
     except Exception as exc:  # noqa: BLE001 — extras never fail the bench
         log(f"per-chip leg skipped: {exc}")
 
@@ -228,7 +239,7 @@ def main() -> None:
     # share the preamble+evidence prefix, prefilled once via the prefix
     # cache (suffix-only chunked admission).  Warm pass first so compile
     # time for the suffix-bucket program stays out of the measurement. ----
-    shared_p50_ms = None
+    shared_p50_ms = shared_p99_ms = None
     try:
         pre = prompt()[:shared_len]
 
@@ -237,12 +248,16 @@ def main() -> None:
                 4, cfg.vocab_size - 4, size=prompt_len - shared_len))
 
         # Seed the cache first (a lone request registers the prefix), THEN
-        # warm the batched chunked-prefill program with a hitting pair —
-        # hits in the same round as the seed would run the dense path and
-        # leave the chunked program to compile inside the measurement.
+        # warm the batched chunked-prefill program at every ladder lane
+        # count a draining burst can hit — hits in the same round as the
+        # seed would run the dense path and leave the chunked programs to
+        # compile inside the measurement.
         eng.generate([shared_prompt()], SamplingParams(max_tokens=4))
-        eng.generate([shared_prompt() for _ in range(2)],
-                     SamplingParams(max_tokens=4))
+        w = 2
+        while w <= ecfg.max_prefills_per_step:
+            eng.generate([shared_prompt() for _ in range(w)],
+                         SamplingParams(max_tokens=4))
+            w *= 2
         st0 = time.monotonic()
         for i in range(n_requests):
             eng.submit(GenerationRequest(
@@ -253,11 +268,11 @@ def main() -> None:
         swall = time.monotonic() - st0
         sres = [eng.poll(f"sh-{i}") for i in range(n_requests)]
         assert all(r is not None and r.finish_reason != "error" for r in sres)
-        shared_p50_ms = float(np.percentile(
-            np.array(sorted(r.ttft_s for r in sres)), 50)) * 1e3
+        shared_p50_ms, shared_p99_ms = ttft_pcts(sres)
         pc = eng.prefix_cache
         log(f"shared-prefix ({shared_len}/{prompt_len} tokens cached): "
-            f"p50 TTFT {shared_p50_ms:.1f} ms, drained in {swall:.2f}s "
+            f"p50 TTFT {shared_p50_ms:.1f} ms, p99 {shared_p99_ms:.1f} ms, "
+            f"drained in {swall:.2f}s "
             f"(cache hits {pc.hits}, misses {pc.misses})")
     except Exception as exc:  # noqa: BLE001 — extras never fail the bench
         log(f"shared-prefix leg skipped: {exc}")
@@ -318,11 +333,65 @@ def main() -> None:
         decode_gbs = reps * K * stream_bytes / ddt / 1e9
         if hbm_peak:
             decode_bw_util = decode_gbs * 1e9 / hbm_peak
+        step_ms = ddt / (reps * K) * 1e3
+        # Attribution of the sub-50% HBM utilization: at B lanes the step
+        # sits at the compute/bandwidth RIDGE — streaming the int8 weights
+        # is only part of the time; the dequantized bf16 matmul at B rows
+        # costs about as much again (plus attention/dispatch residue), so
+        # the step is not HBM-bound and can't reach the bandwidth ceiling.
+        # (Measured v5e: B=8 14.1 ms/step vs B=128 28.2 — the growth is
+        # the B-scaled matmul term; W8A8's s8xs8 matmul cuts it to 24.1.)
+        stream_ms = stream_bytes / hbm_peak * 1e3 if hbm_peak else 0.0
+        matmul_ms = (2.0 * weight_elems * B / flops_peak * 1e3
+                     if flops_peak else 0.0)
+        decode_step_ms, decode_stream_ms, decode_matmul_ms = (
+            step_ms, stream_ms, matmul_ms)
         log(f"decode weight traffic: {decode_gbs:.0f} GB/s"
             + (f" ({decode_bw_util * 100:.0f}% of HBM)" if hbm_peak else "")
             + f" [{B} lanes -> {B * reps * K / ddt:.0f} tok/s ceiling]")
+        log(f"decode step attribution ({B} lanes): {step_ms:.1f} ms/step = "
+            f"weight stream {stream_ms:.1f} + bf16 matmul ~{matmul_ms:.1f} "
+            f"+ residual {max(step_ms - stream_ms - matmul_ms, 0):.1f} "
+            f"(compute/bandwidth ridge, not HBM-bound)")
     except Exception as exc:  # noqa: BLE001
+        decode_step_ms = decode_stream_ms = decode_matmul_ms = None
         log(f"utilization legs skipped: {exc}")
+
+    # --- E2E 128-lane decode saturation: short prompts, generations that
+    # fill each lane's KV capacity, all max_slots lanes live — the engine
+    # (scheduler + reconcile + fused dispatch) at the lane count the
+    # micro-leg ceiling is quoted for. ---------------------------------
+    dec_e2e_tok_s = None
+    try:
+        n_dec = ecfg.max_slots
+        dplen = 64
+        dgen = eng.capacity_tokens - dplen - 1
+        def dec_prompt() -> list[int]:
+            return list(rng.integers(4, cfg.vocab_size - 4, size=dplen))
+        # Warm the short-prompt bucket's admission ladder.
+        w = ecfg.max_prefills_per_step
+        while w >= 1:
+            eng.generate([dec_prompt() for _ in range(w)],
+                         SamplingParams(max_tokens=4))
+            w //= 2
+        dt0 = time.monotonic()
+        for i in range(n_dec):
+            eng.submit(GenerationRequest(
+                request_id=f"dec-{i}", prompt_ids=dec_prompt(),
+                sampling=SamplingParams(max_tokens=dgen)))
+        while eng.has_work:
+            eng.step()
+        dwall = time.monotonic() - dt0
+        dres = [eng.poll(f"dec-{i}") for i in range(n_dec)]
+        assert all(r is not None and r.finish_reason != "error" for r in dres)
+        dtoks = sum(len(r.token_ids) for r in dres)
+        dec_e2e_tok_s = dtoks / dwall
+        ceiling = (f" vs {B * reps * K / ddt:.0f} tok/s fused-step ceiling"
+                   if decode_gbs else "")  # micro-leg may have been skipped
+        log(f"E2E decode saturation ({n_dec} lanes x {dgen} tokens): "
+            f"{dec_e2e_tok_s:.0f} tok/s engine{ceiling}")
+    except Exception as exc:  # noqa: BLE001
+        log(f"decode saturation leg skipped: {exc}")
     del eng  # free the headline KV pool before the long-prompt engine
 
     # --- W8A8 leg: dynamic per-token activation int8 on top of the int8
@@ -330,6 +399,8 @@ def main() -> None:
     # matmul rate on v5e).  Same weights pytree, separate engine/compile.
     # Parity contract: tests/test_quantize.py::test_w8a8_forward_parity. --
     w8a8_p50_ms = w8a8_perchip_p50_ms = w8a8_shared_p50_ms = None
+    w8a8_p99_ms = w8a8_perchip_p99_ms = None
+    w8a8_shared_p99_ms = w8a8_decode_tok_s = None
     w8a8_wall = 0.0
     if quant == "int8" and os.environ.get("BENCH_W8A8", "1") == "1":
         aeng = None
@@ -338,9 +409,13 @@ def main() -> None:
 
             cfg_aq = _dc.replace(cfg, act_quant=True)
             aeng = InferenceEngine(cfg_aq, params, ecfg, eos_id=-1)
-            aeng.generate([prompt() for _ in range(2)],
+            aeng.generate([prompt() for _ in range(ecfg.max_prefills_per_step)],
                           SamplingParams(max_tokens=max_tokens))
-            aeng.generate([prompt()], SamplingParams(max_tokens=4))
+            w = ecfg.max_prefills_per_step // 2
+            while w >= 1:
+                aeng.generate([prompt() for _ in range(w)],
+                              SamplingParams(max_tokens=4))
+                w //= 2
             at0 = time.monotonic()
             for i in range(n_requests):
                 aeng.submit(GenerationRequest(
@@ -352,8 +427,7 @@ def main() -> None:
             ares = [aeng.poll(f"aq-{i}") for i in range(n_requests)]
             assert all(r is not None and r.finish_reason != "error"
                        for r in ares)
-            w8a8_p50_ms = float(np.percentile(
-                np.array(sorted(r.ttft_s for r in ares)), 50)) * 1e3
+            w8a8_p50_ms, w8a8_p99_ms = ttft_pcts(ares)
             n_pc = max(1, n_requests // 8)
             for i in range(n_pc):
                 aeng.submit(GenerationRequest(
@@ -364,9 +438,9 @@ def main() -> None:
             apc = [aeng.poll(f"aqpc-{i}") for i in range(n_pc)]
             assert all(r is not None and r.finish_reason != "error"
                        for r in apc)
-            w8a8_perchip_p50_ms = float(np.percentile(
-                np.array(sorted(r.ttft_s for r in apc)), 50)) * 1e3
-            log(f"W8A8: p50 TTFT {w8a8_p50_ms:.1f} ms at {n_requests} "
+            w8a8_perchip_p50_ms, w8a8_perchip_p99_ms = ttft_pcts(apc)
+            log(f"W8A8: p50 TTFT {w8a8_p50_ms:.1f} ms, p99 "
+                f"{w8a8_p99_ms:.1f} ms at {n_requests} "
                 f"concurrent (drained {w8a8_wall:.2f}s); per-chip-equiv "
                 f"{w8a8_perchip_p50_ms:.1f} ms")
 
@@ -378,8 +452,11 @@ def main() -> None:
                 return pre2 + list(rng.integers(
                     4, cfg.vocab_size - 4, size=prompt_len - shared_len))
             aeng.generate([w8a8_shared()], SamplingParams(max_tokens=4))
-            aeng.generate([w8a8_shared() for _ in range(2)],
-                          SamplingParams(max_tokens=4))
+            w = 2
+            while w <= ecfg.max_prefills_per_step:
+                aeng.generate([w8a8_shared() for _ in range(w)],
+                              SamplingParams(max_tokens=4))
+                w *= 2
             for i in range(n_requests):
                 aeng.submit(GenerationRequest(
                     request_id=f"aqsh-{i}", prompt_ids=w8a8_shared(),
@@ -389,10 +466,41 @@ def main() -> None:
             ash = [aeng.poll(f"aqsh-{i}") for i in range(n_requests)]
             assert all(r is not None and r.finish_reason != "error"
                        for r in ash)
-            w8a8_shared_p50_ms = float(np.percentile(
-                np.array(sorted(r.ttft_s for r in ash)), 50)) * 1e3
-            log(f"W8A8 shared-prefix: p50 TTFT {w8a8_shared_p50_ms:.1f} ms "
+            w8a8_shared_p50_ms, w8a8_shared_p99_ms = ttft_pcts(ash)
+            log(f"W8A8 shared-prefix: p50 TTFT {w8a8_shared_p50_ms:.1f} ms, "
+                f"p99 {w8a8_shared_p99_ms:.1f} ms "
                 f"at {n_requests} concurrent")
+
+            # W8A8 fused-decode step rate at full lanes: the s8 x s8
+            # matmul halves the compute term of the decode-step ridge
+            # (see the attribution print above), so the serving-default
+            # quant mode wins decode too, not just prefill.
+            import jax.numpy as jnp
+
+            Kd, Bd = ecfg.decode_steps_per_iter, ecfg.max_slots
+            prog = aeng._decode_program(Kd, sampled=False)
+            blocks_per = min((prompt_len + 16 + 15) // 16,
+                             ecfg.max_blocks_per_seq)
+            wtbl = np.zeros((Bd, ecfg.max_blocks_per_seq), np.int32)
+            wtbl[:, :blocks_per] = np.arange(1, blocks_per + 1)[None, :]
+            wtbl = jnp.asarray(wtbl)
+            wctx = jnp.full((Bd,), prompt_len, jnp.int32)
+            wrem = jnp.full((Bd,), 10 ** 6, jnp.int32)
+            weos = jnp.asarray(-1, jnp.int32)
+            wtok = jnp.zeros((Bd,), jnp.int32)
+            _, wtok, aeng.pages = prog(params, wtok, wctx, wrem,
+                                       aeng.pages, wtbl, weos)
+            _ = int(wtok[0])
+            wreps = 3
+            wt0 = time.monotonic()
+            for _ in range(wreps):
+                _, wtok, aeng.pages = prog(params, wtok, wctx, wrem,
+                                           aeng.pages, wtbl, weos)
+            _ = int(wtok[0])
+            wddt = time.monotonic() - wt0
+            w8a8_decode_tok_s = Bd * wreps * Kd / wddt
+            log(f"W8A8 decode: {wddt / (wreps * Kd) * 1e3:.1f} ms/step "
+                f"-> {w8a8_decode_tok_s:.0f} tok/s at {Bd} lanes")
         except Exception as exc:  # noqa: BLE001 — extras never fail the bench
             log(f"W8A8 leg skipped: {exc}")
         finally:
@@ -402,7 +510,7 @@ def main() -> None:
     # chunked prefill (prompts > the largest bucket), so the headline number
     # can't hide a slow chunk path.  Separate engine so bucket shapes and the
     # KV pool match the longer sequences.
-    long_p50_ms = None  # omitted from the JSON if the leg doesn't complete
+    long_p50_ms = long_p99_ms = None  # omitted if the leg doesn't complete
     long_shared_p50_ms = None
     long_perchip_p50_ms = None
     try:
@@ -417,6 +525,11 @@ def main() -> None:
             max_prefills_per_step=4,
             max_admission_rounds=4,
             decode_steps_per_iter=8,
+            # Prefill-priority for the burst: with 12 chunk rounds queued,
+            # decode interleaves steal first-token bandwidth — 6 (vs the
+            # default 3) measured 1.42s -> 1.30s p50 AND a faster drain
+            # (2.92 -> 2.73s wall) at 16 concurrent long prompts.
+            decode_every_n_chunk_rounds=6,
         )
         # Long-prompt chunks are pure prefill compute — run them W8A8
         # (same parity contract as the headline W8A8 leg) when the weights
@@ -430,10 +543,13 @@ def main() -> None:
         def long_prompt() -> list[int]:
             return list(rng.integers(4, cfg.vocab_size - 4, size=long_len))
 
-        # Warm both chunk-round lane counts (P=1 and P=max) + decode.
+        # Warm the chunk-round lane ladder (P=1/2/4; the per-chip leg runs
+        # 2 lanes) + the decode K ladder (max_tokens=16 walks K=8,4,2,1).
         leng.generate([long_prompt()], SamplingParams(max_tokens=16))
+        leng.generate([long_prompt() for _ in range(2)],
+                      SamplingParams(max_tokens=16))
         leng.generate([long_prompt() for _ in range(4)],
-                      SamplingParams(max_tokens=8))
+                      SamplingParams(max_tokens=16))
         lt0 = time.monotonic()
         for i in range(n_long):
             leng.submit(GenerationRequest(
@@ -447,10 +563,10 @@ def main() -> None:
         lres = [leng.poll(f"long-{i}") for i in range(n_long)]
         bad = [r for r in lres if r is None or r.finish_reason == "error"]
         assert not bad, f"{len(bad)}/{n_long} long requests failed: {bad[:2]}"
-        long_p50_ms = float(np.percentile(
-            np.array(sorted(r.ttft_s for r in lres)), 50)) * 1e3
+        long_p50_ms, long_p99_ms = ttft_pcts(lres)
         log(f"long prompts ({long_len} tok x {n_long}): p50 TTFT "
-            f"{long_p50_ms:.1f} ms, drained in {lwall:.2f}s")
+            f"{long_p50_ms:.1f} ms, p99 {long_p99_ms:.1f} ms, "
+            f"drained in {lwall:.2f}s")
 
         # Per-chip-equivalent long leg (the SLO's v5e-8 spread over 8).
         n_lpc = max(1, n_long // 8)
@@ -500,7 +616,18 @@ def main() -> None:
     # weight streaming dominates; speculation turns one verify forward into
     # up to spec_k+1 emitted tokens when the output continues an n-gram
     # from its own context (serving/spec.py).  A/B on identical prompts.
+    #
+    # Honesty note: random-init weights never quote their context — every
+    # workload construction tried (random prompts, prompts embedding the
+    # model's own prior greedy continuation, fully periodic prompts)
+    # measures acceptance at exactly the 1.0 floor, because a random
+    # model's argmax never re-walks an n-gram.  So this leg does NOT claim
+    # a speculation speedup; it proves the *adaptive controller's floor
+    # costs nothing* (spec-enabled ~= fused throughput), which is the
+    # property that makes shipping the feature safe.  spec_k defaults to
+    # 0 in the serving config; enable it for real quoting checkpoints.
     spec_tok_s = spec_base_tok_s = spec_tpv = None
+    spec_quote_tpv = None
     try:
         import dataclasses as _dc
 
@@ -527,9 +654,13 @@ def main() -> None:
             # dispatch is speculative and emits only a few tokens, so an
             # 8-token warmup never compiles the fused K=8 program and its
             # multi-second (cache-)compile would land inside the measured
-            # window (observed as a phantom 2-6x "regression").
-            se.generate([sp_prompts[0]] * 2, SamplingParams(max_tokens=24))
-            se.generate([sp_prompts[0]] * 2, SamplingParams(max_tokens=24))
+            # window (observed as a phantom 2-6x "regression").  The first
+            # 8-lane batch covers the P=8 dense admission (and registers
+            # the prefix); the second covers the P=8 *chunked* admission
+            # the measured burst takes when its first prompt hits the
+            # prefix cache.
+            se.generate([sp_prompts[0]] * 8, SamplingParams(max_tokens=24))
+            se.generate([sp_prompts[0]] * 8, SamplingParams(max_tokens=24))
             spt0 = time.monotonic()
             for i, p in enumerate(sp_prompts):
                 se.submit(GenerationRequest(
@@ -555,8 +686,97 @@ def main() -> None:
             else:
                 spec_base_tok_s = tput
             del se
+
+        # Record the most favorable honest quoting construction in the
+        # artifact: prompts embedding the model's own prior greedy
+        # continuation (P + G + P + G[:16], so the true continuation of a
+        # quoting model WOULD be G[16:], and the n-gram proposer drafts
+        # exactly that).  spec_min_accept=0 disables the adaptive
+        # fallback so the number is true acceptance, not the probe EMA.
+        qe = InferenceEngine(
+            cfg, params,
+            _dc.replace(sp_base, spec_k=4, spec_min_accept=0.0),
+            eos_id=-1)
+        qps = [prompt()[:64] for _ in range(8)]
+        qouts = qe.generate(qps, SamplingParams(max_tokens=48))
+        qe.spec_tokens = qe.spec_verify_steps = qe.spec_lane_rounds = 0
+        qe.generate([p + r.token_ids + p + r.token_ids[:16]
+                     for p, r in zip(qps, qouts)],
+                    SamplingParams(max_tokens=48))
+        spec_quote_tpv = qe.spec_tokens / max(qe.spec_lane_rounds, 1)
+        log(f"spec self-quote construction: {spec_quote_tpv:.2f} accepted "
+            f"tokens/lane-round (1.0 = floor; random weights don't quote)")
+        del qe
     except Exception as exc:  # noqa: BLE001 — extras never fail the bench
         log(f"spec-decode leg skipped: {exc}")
+
+    # --- long-context verify: the Pallas multi-query kernel on a measured
+    # path.  At >= 2048-token tables (the VERIFY_KERNEL_MIN_TABLE_TOKENS
+    # gate) the engine selects paged_verify_attention_pallas for spec
+    # verify; this leg runs BOTH impls on the same long-context spec
+    # workload so the artifact re-measures the kernel-vs-gather crossover
+    # every round instead of shipping a stale gate. -------------------
+    vk_tok_s = vg_tok_s = None
+    try:
+        import dataclasses as _dc
+
+        from k8s_llm_monitor_tpu.ops import attention as _attn
+
+        vcfg_e = EngineConfig(
+            max_slots=8, num_blocks=8 * 128 + 32, block_size=16,
+            max_blocks_per_seq=128,              # 2048-token tables
+            prefill_buckets=(512,), max_prefills_per_step=4,
+            max_admission_rounds=4, decode_steps_per_iter=8,
+            spec_k=4, spec_rounds_per_iter=4,
+            spec_min_accept=0.0,                 # always speculate: the
+            # leg measures the verify IMPL, not acceptance (floor = 1.0)
+        )
+        vlen, vgen, nv = 1700, 48, 8
+
+        def vprompt() -> list[int]:
+            return list(rng.integers(4, cfg.vocab_size - 4, size=vlen))
+
+        saved_gate = _attn.VERIFY_KERNEL_MIN_TABLE_TOKENS
+        for force_gather in (False, True):
+            # The gate is a module constant consulted at engine build;
+            # raising it beyond the table size forces the gather impl for
+            # the A/B.  Restored in finally.
+            _attn.VERIFY_KERNEL_MIN_TABLE_TOKENS = (
+                10 ** 9 if force_gather else saved_gate)
+            try:
+                ve = InferenceEngine(cfg, params, vcfg_e, eos_id=-1)
+                if (not force_gather and dev.platform == "tpu"):
+                    from k8s_llm_monitor_tpu.ops.pallas_attention import (
+                        paged_verify_attention_pallas,
+                    )
+                    assert ve._verify_impl is paged_verify_attention_pallas
+                for w in (1, 2, 4):
+                    ve.generate([vprompt() for _ in range(w)],
+                                SamplingParams(max_tokens=16))
+                vt0 = time.monotonic()
+                for i in range(nv):
+                    ve.submit(GenerationRequest(
+                        request_id=f"vk-{i}", prompt_ids=vprompt(),
+                        sampling=SamplingParams(max_tokens=vgen)))
+                while ve.has_work:
+                    ve.step()
+                vdt = time.monotonic() - vt0
+                vres = [ve.poll(f"vk-{i}") for i in range(nv)]
+                assert all(r is not None and r.finish_reason != "error"
+                           for r in vres)
+                tput = sum(len(r.token_ids) for r in vres) / vdt
+                if force_gather:
+                    vg_tok_s = tput
+                else:
+                    vk_tok_s = tput
+                del ve
+            finally:
+                _attn.VERIFY_KERNEL_MIN_TABLE_TOKENS = saved_gate
+        log(f"long-context spec verify ({vlen}-token ctx, 2048-token "
+            f"tables): Pallas kernel {vk_tok_s:.0f} tok/s vs XLA gather "
+            f"{vg_tok_s:.0f} tok/s")
+    except Exception as exc:  # noqa: BLE001 — extras never fail the bench
+        log(f"long-context verify leg skipped: {exc}")
 
     # BASELINE config #3: encoder embedding throughput (BGE-large geometry
     # on TPU, tiny on CPU smoke runs), via the anomaly detector's batch path.
@@ -584,6 +804,61 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001 — extras never fail the bench
         log(f"encoder bench skipped: {exc}")
 
+    # BASELINE config #1: ONE /api/v1/query root-cause request end-to-end
+    # through the booted HTTP server (fake cluster, template backend — the
+    # zero-accelerator CPU path), timed as a real HTTP round trip including
+    # evidence collection.  The reference documents this endpoint but never
+    # implemented it (README.md:89-95 vs cmd/server/main.go:97-141), so
+    # this is the number it has no counterpart for.
+    query_e2e_ms = None
+    try:
+        import urllib.request
+
+        from k8s_llm_monitor_tpu.monitor.analysis import (
+            AnalysisEngine,
+            TemplateBackend,
+        )
+        from k8s_llm_monitor_tpu.monitor.client import Client
+        from k8s_llm_monitor_tpu.monitor.cluster import (
+            FakeCluster,
+            seed_demo_cluster,
+        )
+        from k8s_llm_monitor_tpu.monitor.config import Config, MetricsConfig
+        from k8s_llm_monitor_tpu.monitor.manager import Manager
+        from k8s_llm_monitor_tpu.monitor.server import MonitorServer
+
+        fake = seed_demo_cluster(FakeCluster())
+        qclient = Client(fake, namespaces=["default", "kube-system"])
+        qmanager = Manager(
+            qclient, MetricsConfig(namespaces=["default"],
+                                   enable_network=True))
+        qmanager.collect()
+        qanalysis = AnalysisEngine(
+            TemplateBackend(), client=qclient, manager=qmanager)
+        srv = MonitorServer(config=Config(), client=qclient,
+                            manager=qmanager, analysis=qanalysis, port=0)
+        srv.start()
+        qreq = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/api/v1/query",
+            data=json.dumps(
+                {"question": "why is the web pod failing to reach the "
+                             "database service?"}).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        with urllib.request.urlopen(qreq) as r:  # warm the route once
+            r.read()
+        qtimes = []
+        for _ in range(5):
+            qt0 = time.monotonic()
+            with urllib.request.urlopen(qreq) as r:
+                r.read()
+            qtimes.append(time.monotonic() - qt0)
+        query_e2e_ms = float(np.median(qtimes)) * 1e3
+        srv.stop()
+        log(f"query E2E (HTTP round trip, fake cluster, template backend): "
+            f"{query_e2e_ms:.1f} ms")
+    except Exception as exc:  # noqa: BLE001 — extras never fail the bench
+        log(f"query E2E leg skipped: {exc}")
+
     extras = {
         "model": model_name,
         "quant": quant,
@@ -601,11 +876,20 @@ def main() -> None:
         "embed_docs_per_s": round(embed_docs_per_s, 1),
         "slo_context": "500ms SLO is v5e-8 (8 chips); this is 1 chip at "
                        "8x the SLO's per-chip load",
+        # Tail budget: with a uniform-length burst admitted FIFO, p99 TTFT
+        # ~= the serial prefill time of the whole burst on this one chip
+        # (admission-order physics, not queue mismanagement); an 8-chip
+        # deployment divides it by the chip count.
+        "tail_budget": "p99 ~= burst_prefill_total / n_chips",
     }
+    if query_e2e_ms is not None:
+        extras["query_e2e_ms"] = round(query_e2e_ms, 2)
     if perchip_p50_ms is not None:
         extras["perchip_equiv_p50_ttft_ms"] = round(perchip_p50_ms, 2)
+        extras["perchip_equiv_p99_ttft_ms"] = round(perchip_p99_ms, 2)
     if shared_p50_ms is not None:
         extras["shared_prefix_p50_ttft_ms"] = round(shared_p50_ms, 2)
+        extras["shared_prefix_p99_ttft_ms"] = round(shared_p99_ms, 2)
         extras["shared_prefix_len"] = shared_len
     if prefill_tflops:
         extras["prefill_tflops"] = round(prefill_tflops, 1)
@@ -613,8 +897,20 @@ def main() -> None:
     if decode_gbs:
         extras["decode_weight_gbs"] = round(decode_gbs, 1)
         extras["decode_bw_util"] = round(decode_bw_util, 3)
+        if decode_step_ms is not None:
+            extras["decode_step_ms"] = round(decode_step_ms, 2)
+            extras["decode_step_stream_ms"] = round(decode_stream_ms, 2)
+            extras["decode_step_matmul_ms"] = round(decode_matmul_ms, 2)
+            extras["decode_attribution"] = (
+                "compute/bandwidth ridge at this lane count: weight "
+                "streaming + B-scaled matmul each ~10ms; not HBM-bound")
+    if dec_e2e_tok_s is not None:
+        extras["decode_e2e_128lane_tok_s"] = round(dec_e2e_tok_s, 1)
+    if w8a8_decode_tok_s is not None:
+        extras["w8a8_decode_tok_s"] = round(w8a8_decode_tok_s, 1)
     if long_p50_ms is not None:  # 0.0 would read as a perfect score
         extras["long_prompt_p50_ttft_ms"] = round(long_p50_ms, 2)
+        extras["long_prompt_p99_ttft_ms"] = round(long_p99_ms, 2)
         extras["long_quant"] = "w8a8" if quant == "int8" else quant
     if long_shared_p50_ms is not None:
         extras["long_shared_prefix_p50_ttft_ms"] = round(long_shared_p50_ms, 2)
@@ -622,15 +918,27 @@ def main() -> None:
         extras["long_perchip_equiv_p50_ttft_ms"] = round(long_perchip_p50_ms, 2)
     if w8a8_p50_ms is not None:
         extras["w8a8_p50_ttft_ms"] = round(w8a8_p50_ms, 2)
+        extras["w8a8_p99_ttft_ms"] = round(w8a8_p99_ms, 2)
         extras["w8a8_wall_s"] = round(w8a8_wall, 2)
     if w8a8_perchip_p50_ms is not None:
         extras["w8a8_perchip_p50_ttft_ms"] = round(w8a8_perchip_p50_ms, 2)
+        extras["w8a8_perchip_p99_ttft_ms"] = round(w8a8_perchip_p99_ms, 2)
     if w8a8_shared_p50_ms is not None:
         extras["w8a8_shared_prefix_p50_ttft_ms"] = round(w8a8_shared_p50_ms, 2)
+        extras["w8a8_shared_prefix_p99_ttft_ms"] = round(
+            w8a8_shared_p99_ms, 2)
     if spec_tok_s is not None:
         extras["spec_decode_tok_s"] = round(spec_tok_s, 1)
         extras["spec_baseline_tok_s"] = round(spec_base_tok_s, 1)
         extras["spec_accept_per_lane_round"] = round(spec_tpv, 2)
+        extras["spec_default"] = "off (spec_k=0): random-init weights "\
+            "measure the 1.0 acceptance floor on every construction; "\
+            "this leg proves the adaptive floor costs ~nothing"
+    if spec_quote_tpv is not None:
+        extras["spec_selfquote_accept"] = round(spec_quote_tpv, 2)
+    if vk_tok_s is not None and vg_tok_s is not None:
+        extras["verify_kernel_longctx_tok_s"] = round(vk_tok_s, 1)
+        extras["verify_gather_longctx_tok_s"] = round(vg_tok_s, 1)
     log(f"total bench time {time.monotonic() - t0:.0f}s")
     print(json.dumps({
         "metric": "p50_ttft_100c_ms",
