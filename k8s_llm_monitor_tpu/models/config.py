@@ -44,7 +44,8 @@ class ModelConfig:
     kv_dtype: str = ""
     # W8A8: dynamically quantize activations (per-token symmetric int8) at
     # every linear so the matmul runs s8 x s8 on the MXU's int8 path —
-    # ~2-3x the bf16 matmul rate on v5e, i.e. ~2x faster prefill for
+    # above the bf16 matmul rate on v5e (measured ~1.4x end-to-end on
+    # dense prefill shapes), i.e. faster prefill for
     # int8-quantized weights.  Requires kernel_q weights
     # (utils/quantize.py).  Attention, norms, and residuals stay bf16.
     act_quant: bool = False

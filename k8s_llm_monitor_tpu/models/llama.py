@@ -174,7 +174,7 @@ def _linear(p: Params, x: jnp.ndarray, act_quant: bool = False) -> jnp.ndarray:
             stacklevel=2)
     if "kernel_q" in p:
         if act_quant:
-            # W8A8: s8 x s8 -> s32 on the MXU int8 path (~2-3x the bf16
+            # W8A8: s8 x s8 -> s32 on the MXU int8 path (measured ~1.4x the bf16
             # rate on v5e); both scales factor out of the contraction.
             x_q, xs = _quant_act(x)
             y32 = jax.lax.dot_general(

@@ -44,7 +44,8 @@ class TPULLMConfig:
     model: str = "llama-1b"  # preset name in models/config.py PRESETS
     checkpoint: str = ""  # HF checkpoint dir ('' => random-init dev weights)
     # "int8" = weight-only quantization; "w8a8" = int8 weights + dynamic
-    # per-token activation int8 (s8 x s8 prefill, ~2.6x on v5e); '' = bf16.
+    # per-token activation int8 (s8 x s8 prefill, measured ~1.4x the bf16
+    # matmul rate on v5e); '' = bf16.
     # W8A8 is the declared serving default: it is the only mode that meets
     # every short-leg SLO in the driver-captured bench artifacts
     # (BENCH_r04/r05), and its logits parity against the bf16 path is

@@ -200,6 +200,36 @@ def test_long_prompts_stream_and_batch_chunks(params):
         assert r.token_ids == _naive_greedy(params, p, 5)
 
 
+def test_mixed_progress_chunk_rounds_match_naive(params):
+    """Staggered long prompts of different lengths put lanes at different
+    prefill depths in the SAME chunk round, exercising the narrowed block
+    table (width = deepest lane's coverage) with shallower lanes' tables
+    truncated; outputs must still match naive decoding exactly."""
+    eng = InferenceEngine(
+        CFG, params,
+        EngineConfig(max_slots=4, num_blocks=128, block_size=8,
+                     max_blocks_per_seq=16, prefill_buckets=(16,),
+                     max_prefills_per_step=4),
+        eos_id=-1,
+    )
+    rng = np.random.default_rng(11)
+    first = list(rng.integers(3, 300, size=90))   # deep lane
+    later = [list(rng.integers(3, 300, size=n)) for n in (34, 70)]
+    eng.submit(GenerationRequest("deep", first,
+                                 SamplingParams(max_tokens=4)))
+    eng.step()  # admit + first chunk round for the deep lane
+    for i, p in enumerate(later):
+        eng.submit(GenerationRequest(f"late-{i}", p,
+                                     SamplingParams(max_tokens=4)))
+    while eng.has_work:
+        eng.step()
+    for rid, p in [("deep", first)] + [
+            (f"late-{i}", p) for i, p in enumerate(later)]:
+        r = eng.poll(rid)
+        assert r is not None and r.finish_reason == "length"
+        assert r.token_ids == _naive_greedy(params, p, 4), rid
+
+
 def test_cancel_mid_prefill_settles_cleanly(params):
     """Cancelling a long prompt while its chunks are still streaming must
     retire the slot, free its pages, and report an eos/length-free result

@@ -234,3 +234,55 @@ def test_stress_long_prompts_shared_prefixes_and_cancels(params, spec_k):
     assert eng.prefix_cache.hits > 0           # the shared tails actually hit
     eng.prefix_cache.clear()
     assert eng.allocator.free_blocks == 56 - 1  # no leaked blocks
+
+
+def test_stress_seq_parallel_mesh_long_prompts(params, cpu_mesh_devices):
+    """The seq-sharded prefill path (engine._tokens_to_device) under churn:
+    a data=1 x seq=2 x model=2 mesh with chunk-streamed long prompts,
+    prefix hits, preemption pressure, and a cancel — must drain cleanly
+    and match the unsharded engine's greedy outputs."""
+    from k8s_llm_monitor_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    mesh = create_mesh(MeshConfig(data=1, seq=2, model=2),
+                       devices=cpu_mesh_devices[:4])
+    ecfg = EngineConfig(max_slots=4, num_blocks=56, block_size=4,
+                        max_blocks_per_seq=32, prefill_buckets=(8, 16),
+                        max_prefills_per_step=4, max_admission_rounds=2,
+                        decode_steps_per_iter=4, max_inflight=2,
+                        decode_every_n_chunk_rounds=2)
+    rng = np.random.default_rng(21)
+    prefix = list(rng.integers(8, 300, size=20))
+    prompts = {
+        "long-a": list(rng.integers(8, 300, size=44)),
+        "long-b": list(rng.integers(8, 300, size=37)),
+        "hit": prefix + list(rng.integers(8, 300, size=4)),
+        "short": list(rng.integers(8, 300, size=5)),
+        "victim": list(rng.integers(8, 300, size=50)),
+    }
+
+    def drive(engine):
+        engine.generate([prefix], SamplingParams(max_tokens=1))  # seed cache
+        for rid, p in prompts.items():
+            engine.submit(GenerationRequest(
+                rid, list(p), SamplingParams(max_tokens=6)))
+        steps = 0
+        while engine.has_work:
+            engine.step()
+            steps += 1
+            if steps == 3:
+                engine.cancel("victim")
+            assert steps < 5_000
+        return {rid: engine.poll(rid) for rid in prompts}
+
+    plain = drive(InferenceEngine(CFG, params, ecfg, eos_id=-1))
+    sq_eng = InferenceEngine(CFG, params, ecfg, eos_id=-1, mesh=mesh)
+    assert sq_eng._tok_sharding is not None
+    sq = drive(sq_eng)
+    for rid in prompts:
+        assert sq[rid] is not None, f"{rid} dropped"
+        if rid == "victim":
+            continue  # cancel timing is scheduler-dependent
+        assert sq[rid].finish_reason == plain[rid].finish_reason
+        assert sq[rid].token_ids == plain[rid].token_ids, rid
+    sq_eng.prefix_cache.clear()
+    assert sq_eng.allocator.free_blocks == 56 - 1
