@@ -71,6 +71,17 @@ def _engine_metrics(w: _Writer, engine) -> None:
     w.metric("engine_preemptions_total", "counter",
              "Recompute-preemptions under KV pressure",
              [("", engine.preemptions)])
+    if engine.prefix_cache is not None:
+        w.metric("engine_prefix_cache_hits_total", "counter",
+                 "Admissions served a cached prompt prefix",
+                 [("", engine.prefix_cache.hits)])
+        w.metric("engine_prefix_cache_misses_total", "counter",
+                 "Admissions that found no cached prefix",
+                 [("", engine.prefix_cache.misses)])
+        w.metric("engine_prefix_deferrals_total", "counter",
+                 "Requests whose admission waited for a publishing "
+                 "same-prefix lane (cold-burst dedup)",
+                 [("", engine.prefix_deferrals)])
     w.metric("engine_spec_tokens_total", "counter",
              "Tokens emitted by speculative-decode dispatches",
              [("", engine.spec_tokens)])

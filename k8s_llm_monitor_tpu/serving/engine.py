@@ -66,6 +66,7 @@ from k8s_llm_monitor_tpu.serving.kv_cache import (
     BlockAllocator,
     OutOfBlocks,
     PrefixCache,
+    shareable_blocks,
 )
 from k8s_llm_monitor_tpu.serving.spec import (
     accept_greedy,
@@ -92,6 +93,11 @@ class GenerationRequest:
     # generated output folded back in by preemption.
     orig_prompt_len: int = -1
     first_token_time: float = 0.0
+    # Cold-burst dedup: set the first time admission holds this request
+    # back so a same-prefix lane can publish the shared pages first
+    # (engine._admit_round); caps the dense-lane rule at one round and
+    # keeps the deferral counter per-request.
+    prefix_deferred: bool = False
 
 
 @dataclasses.dataclass
@@ -299,6 +305,9 @@ class InferenceEngine:
         self.prefix_cache: Optional[PrefixCache] = (
             PrefixCache(self.allocator, ec.prefix_cache_entries)
             if ec.prefix_cache_entries > 0 else None)
+        # Cold-burst shared-prefix dedup: requests whose admission waited
+        # for an in-flight lane to publish their prefix.
+        self.prefix_deferrals = 0
 
         if attn_impl is None:
             from k8s_llm_monitor_tpu.ops.attention import select_attn_impl
@@ -665,6 +674,31 @@ class InferenceEngine:
                 return False
         return True
 
+    def _pending_prefix_gain(
+        self, cand: list[int], publishers: list[list[int]],
+    ) -> int:
+        """Tokens of ``cand``'s prefix that become cache-sharable once the
+        ``publishers`` prompts register their pages (block-aligned, capped
+        at both prompts' shareable spans — kv_cache.shareable_blocks)."""
+        bs = self.ecfg.block_size
+        cand_blocks = shareable_blocks(len(cand), bs)
+        if cand_blocks <= 0:
+            return 0
+        best = 0
+        for other in publishers:
+            if cand[:bs] != other[:bs]:
+                continue
+            # Whole-block slice compares (C-speed) — only full blocks are
+            # ever sharable, so per-token resolution buys nothing.
+            nb = min(shareable_blocks(len(other), bs), cand_blocks)
+            if nb <= 0:
+                continue
+            k = 1
+            while k < nb and cand[k * bs:(k + 1) * bs] == other[k * bs:(k + 1) * bs]:
+                k += 1
+            best = max(best, k * bs)
+        return best
+
     def _admit_round(self) -> bool:
         """Dispatch one batched prefill+sample call for up to
         ``max_prefills_per_step`` pending prompts.  Returns True if anything
@@ -674,14 +708,51 @@ class InferenceEngine:
         prefill into a suffix-only chunked ingestion over the shared pages.
         Rounds where every lane is a miss keep the dense prefill path (no
         page gather); any hit switches the round to the chunked program.
+
+        Cold-burst dedup, two rules sharing one economic gate (the
+        published span must cover at least half the candidate's remaining
+        prefill work):
+
+        * a candidate sharing a prefix with a *dense lane admitted this
+          round* (pages publish at dispatch) is held back exactly one
+          round — 100 simultaneous same-evidence diagnosis queries
+          prefill their shared prefix once, not max_prefills_per_step
+          times;
+        * a *chunk-path* candidate (suffix wider than the largest bucket)
+          sharing a prefix with a slot still streaming its chunks waits
+          until that publisher's final chunk registers the pages — chunk
+          rounds advance every step regardless of admissions, so the wait
+          is bounded and the candidate then admits suffix-only.  Short
+          candidates never wait on a streaming publisher (their own
+          prefill costs at most one bucket).
         """
         ec = self.ecfg
         top = ec.prefill_buckets[-1]
         free = self._free_slots()
         admitted_long = 0
+        deferred: list[GenerationRequest] = []
+        round_prompts: list[list[int]] = []
+        # Prompts whose pages will register when their streaming prefill
+        # completes: live chunk-path slots + this round's long admissions.
+        publishing: list[list[int]] = (
+            [s.req.prompt_ids for s in self._slots
+             if s is not None and s.prefilling and not s.retired
+             and not s.cancel_requested]
+            if self.prefix_cache is not None else [])
+        # Deferral work per round is bounded: past this many held-back
+        # candidates the scan stops (the rest stay pending and hit the
+        # cache next round) — a 10k-deep cold queue must not stall the
+        # scheduler thread inside one admission round.
+        defer_budget = 4 * ec.max_prefills_per_step
         # Entries: (slot_idx, req, blocks, shared_toks)
         batch: list[tuple[int, GenerationRequest, list[int], int]] = []
         while len(batch) < ec.max_prefills_per_step and self._pending and free:
+            if len(deferred) >= defer_budget:
+                # Stop the scan, not just the deferring: candidates past
+                # the budget stay pending (and will hit the cache next
+                # round) instead of being admitted into a redundant
+                # prefix recompute.
+                break
             req = self._pending[0]
             L = len(req.prompt_ids)
             if L + 1 > self.capacity_tokens:
@@ -696,6 +767,38 @@ class InferenceEngine:
             shared_toks = 0
             if self.prefix_cache is not None:
                 shared, shared_toks = self.prefix_cache.lookup(req.prompt_ids)
+                suffix = L - shared_toks
+
+                def worth(gain: int) -> bool:
+                    # The one economic gate both rules share: the published
+                    # span must beat the current hit AND cover at least
+                    # half the prefill work still ahead of this candidate.
+                    return (gain > shared_toks
+                            and 2 * (gain - shared_toks) >= suffix)
+
+                defer = False
+                if not req.prefix_deferred and round_prompts:
+                    defer = worth(self._pending_prefix_gain(
+                        req.prompt_ids, round_prompts))
+                if not defer and suffix > top and publishing:
+                    # Chunk-path candidate: wait for a streaming publisher
+                    # (re-evaluated each round; no flag — the wait ends
+                    # when the publisher's final chunk registers, or
+                    # immediately if it is preempted or cancelled).
+                    defer = worth(self._pending_prefix_gain(
+                        req.prompt_ids, publishing))
+                if defer:
+                    if shared:
+                        self.allocator.free(shared)
+                    if not req.prefix_deferred:
+                        # Counts requests ever deferred, not rounds held —
+                        # a chunk-path candidate may wait several rounds
+                        # on one streaming publisher.
+                        req.prefix_deferred = True
+                        self.prefix_deferrals += 1
+                    self._pending.popleft()
+                    deferred.append(req)
+                    continue
             if not self._ensure_free(L + 1 - shared_toks):
                 if shared:
                     self.allocator.free(shared)
@@ -725,8 +828,15 @@ class InferenceEngine:
                 self._slots[slot_idx] = slot
                 self._write_hist([(slot_idx, req)])
                 admitted_long += 1
+                if self.prefix_cache is not None:
+                    publishing.append(req.prompt_ids)
                 continue
             batch.append((free.pop(0), req, blocks, shared_toks))
+            round_prompts.append(req.prompt_ids)
+        if deferred:
+            # Back to the queue head in original order: next round's
+            # lookups hit the pages this round's dispatch publishes.
+            self._pending.extendleft(reversed(deferred))
         if not batch:
             return admitted_long > 0
 

@@ -43,6 +43,15 @@ import hashlib
 import numpy as np
 
 
+def shareable_blocks(n_tokens: int, block_size: int) -> int:
+    """Full blocks of a prompt that may be published for prefix reuse,
+    leaving >= 1 unshared token (the final prompt token must run through
+    prefill to produce the first-token logits).  The single source of
+    truth for the shareable-span rule — PrefixCache.lookup/register and
+    the engine's admission deferral gate must agree on it exactly."""
+    return min(n_tokens // block_size, (n_tokens - 1) // block_size)
+
+
 class OutOfBlocks(Exception):
     pass
 
@@ -155,11 +164,7 @@ class PrefixCache:
         return digests
 
     def _shareable_blocks(self, prompt_ids: list[int]) -> int:
-        """Full blocks covered by the prompt, leaving >= 1 unshared token
-        (the final prompt token must run through prefill to produce the
-        first-token logits)."""
-        bs = self.allocator.block_size
-        return min(len(prompt_ids) // bs, (len(prompt_ids) - 1) // bs)
+        return shareable_blocks(len(prompt_ids), self.allocator.block_size)
 
     def _touch(self, key: bytes, entry: _PrefixEntry) -> None:
         del self._entries[key]

@@ -146,3 +146,65 @@ def test_bf16_encoder_tracks_f32():
     e16 = np.asarray(det16.embed(docs))
     cos = (e32 * e16).sum(-1)  # both L2-normalized
     assert cos.min() > 0.99, cos
+
+
+def test_from_checkpoint_disk_bert_with_hf_tokenizer(tmp_path):
+    """The production seam behind ``analysis.embedding_model: <path>``
+    (monitor/server.py boot): a BertModel checkpoint directory ON DISK plus
+    its saved tokenizer -> ``EmbeddingAnomalyDetector.from_checkpoint`` ->
+    embeddings that match transformers' CLS output over the HF-tokenized
+    ids.  Every other encoder test converts an in-memory state dict; this
+    one proves the disk + AutoTokenizer branch (anomaly.py from_checkpoint)."""
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    from tokenizers import Tokenizer, models, pre_tokenizers, processors
+
+    words = ("pod node oom killed restart dns network error warning "
+             "battery uav scheduler image pull ready probe the a is").split()
+    vocab = {"[PAD]": 0, "[UNK]": 1, "[CLS]": 2, "[SEP]": 3}
+    for w in words:
+        vocab.setdefault(w, len(vocab))
+    tok = Tokenizer(models.WordLevel(vocab, unk_token="[UNK]"))
+    tok.pre_tokenizer = pre_tokenizers.Whitespace()
+    tok.post_processor = processors.TemplateProcessing(
+        single="[CLS] $A [SEP]",
+        special_tokens=[("[CLS]", 2), ("[SEP]", 3)])
+    fast = transformers.PreTrainedTokenizerFast(
+        tokenizer_object=tok, pad_token="[PAD]", unk_token="[UNK]",
+        cls_token="[CLS]", sep_token="[SEP]")
+
+    hf_cfg = transformers.BertConfig(
+        vocab_size=CFG.vocab_size, hidden_size=CFG.hidden_size,
+        num_hidden_layers=CFG.num_layers, num_attention_heads=CFG.num_heads,
+        intermediate_size=CFG.intermediate_size,
+        max_position_embeddings=CFG.max_position_embeddings,
+        hidden_act="gelu", hidden_dropout_prob=0.0,
+        attention_probs_dropout_prob=0.0)
+    model = transformers.BertModel(hf_cfg, add_pooling_layer=False).eval()
+
+    ckpt = tmp_path / "bert-ckpt"
+    model.save_pretrained(ckpt, safe_serialization=True)
+    fast.save_pretrained(ckpt)
+    assert (ckpt / "model.safetensors").exists()
+
+    det = EmbeddingAnomalyDetector.from_checkpoint(str(ckpt))
+    # The HF tokenizer branch must be taken, not the hashing fallback.
+    assert not isinstance(det.tokenizer, HashingTokenizer)
+    assert det.tokenizer.encode("pod oom killed", 16)[0] == 2  # [CLS]
+
+    texts = ["pod oom killed restart", "dns error warning",
+             "uav battery scheduler", "image pull error"]
+    got = det.embed(texts)
+    assert got.shape == (4, CFG.hidden_size)
+
+    batch = fast(texts, padding=True, return_tensors="pt")
+    with torch.no_grad():
+        hidden = model(**{k: batch[k] for k in
+                          ("input_ids", "attention_mask")}).last_hidden_state
+    cls = hidden[:, 0, :].numpy()
+    want = cls / np.maximum(
+        np.linalg.norm(cls, axis=-1, keepdims=True), 1e-9)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-3)
+
+    # The detector built from disk drives the scoring surface end-to-end.
+    assert len(det.score(texts)) == 4

@@ -228,3 +228,119 @@ def test_prefix_cache_disabled(params):
     p = list(np.random.default_rng(4).integers(3, 300, size=20))
     r = eng.generate([p, list(p)], SamplingParams(max_tokens=4))
     assert all(x.token_ids == _naive_greedy(params, p, 4) for x in r)
+
+
+def test_cold_burst_prefills_shared_prefix_once(params):
+    """A simultaneous burst of same-prefix requests with a COLD cache (the
+    /api/v1/query shape right after a new snapshot) computes the prefix in
+    one lane: every other candidate is deferred one admission round and
+    admits as a suffix-only hit.  Outputs stay exactly greedy."""
+    eng = _engine(params, max_slots=8, max_prefills_per_step=8)
+    rng = np.random.default_rng(7)
+    prefix = list(rng.integers(3, 300, size=24))   # 3 full blocks at bs=8
+    prompts = [prefix + list(rng.integers(3, 300, size=4)) for _ in range(5)]
+    for i, p in enumerate(prompts):
+        eng.submit(GenerationRequest(f"c{i}", list(p),
+                                     SamplingParams(max_tokens=5)))
+    while eng.has_work:
+        eng.step()
+    assert eng.prefix_deferrals == 4
+    assert eng.prefix_cache.hits >= 4      # the deferred lanes all hit
+    assert eng.prefix_cache.misses <= 1    # only the publishing lane missed
+    for i, p in enumerate(prompts):
+        res = eng.poll(f"c{i}")
+        assert res is not None and res.finish_reason == "length"
+        assert res.token_ids == _naive_greedy(params, p, 5)
+
+
+def test_cold_burst_defers_per_distinct_prefix(params):
+    """Two prefix groups plus an unrelated prompt in one cold burst: one
+    publisher per group, one deferral per duplicate, nothing deferred
+    twice, and nothing deferred for the unrelated prompt."""
+    eng = _engine(params, max_slots=8, max_prefills_per_step=8,
+                  num_blocks=128)
+    rng = np.random.default_rng(8)
+    pre_a = list(rng.integers(3, 300, size=24))
+    pre_b = list(rng.integers(3, 300, size=24))
+    prompts = [
+        pre_a + [11, 12, 13],
+        pre_a + [14, 15],
+        pre_b + [16, 17, 18],
+        pre_b + [19, 20],
+        list(rng.integers(3, 300, size=20)),  # unrelated
+    ]
+    for i, p in enumerate(prompts):
+        eng.submit(GenerationRequest(f"g{i}", list(p),
+                                     SamplingParams(max_tokens=4)))
+    while eng.has_work:
+        eng.step()
+    assert eng.prefix_deferrals == 2       # one per duplicate, once each
+    for i, p in enumerate(prompts):
+        res = eng.poll(f"g{i}")
+        assert res is not None
+        assert res.token_ids == _naive_greedy(params, p, 4)
+
+
+def test_tiny_shared_prefix_not_worth_deferring(params):
+    """Deferral is gated on the published prefix covering >= half the
+    candidate's remaining prefill work — a 1-block prefix on a 28-token
+    prompt admits immediately instead of waiting a round."""
+    eng = _engine(params, max_slots=8, max_prefills_per_step=8)
+    rng = np.random.default_rng(9)
+    prefix = list(rng.integers(3, 300, size=8))    # 1 block of 28 tokens
+    prompts = [prefix + list(rng.integers(3, 300, size=20))
+               for _ in range(3)]
+    for i, p in enumerate(prompts):
+        eng.submit(GenerationRequest(f"t{i}", list(p),
+                                     SamplingParams(max_tokens=3)))
+    while eng.has_work:
+        eng.step()
+    assert eng.prefix_deferrals == 0
+    for i, p in enumerate(prompts):
+        res = eng.poll(f"t{i}")
+        assert res is not None
+        assert res.token_ids == _naive_greedy(params, p, 3)
+
+
+def test_long_cold_burst_waits_for_streaming_publisher(params):
+    """Two long same-prefix prompts submitted together with a COLD cache:
+    the first streams its chunks; the second (chunk-path) waits until the
+    publisher's final chunk registers the pages, then admits suffix-only
+    as a hit — the shared prefix is ingested once."""
+    eng = _engine(params, max_slots=4, num_blocks=128, max_blocks_per_seq=16,
+                  prefill_buckets=(16,), max_prefills_per_step=4)
+    rng = np.random.default_rng(11)
+    prefix = list(rng.integers(3, 300, size=48))   # 6 blocks, 3 chunk rounds
+    p1 = prefix + list(rng.integers(3, 300, size=20))  # suffix 20 > bucket 16
+    p2 = prefix + list(rng.integers(3, 300, size=21))
+    eng.submit(GenerationRequest("l1", list(p1), SamplingParams(max_tokens=4)))
+    eng.submit(GenerationRequest("l2", list(p2), SamplingParams(max_tokens=4)))
+    while eng.has_work:
+        eng.step()
+    assert eng.prefix_deferrals == 1
+    assert eng.prefix_cache.hits >= 1
+    r1, r2 = eng.poll("l1"), eng.poll("l2")
+    assert r1.token_ids == _naive_greedy(params, p1, 4)
+    assert r2.token_ids == _naive_greedy(params, p2, 4)
+
+
+def test_publisher_cancel_releases_waiting_candidate(params):
+    """A chunk-path candidate waiting on a streaming publisher admits
+    normally once the publisher is cancelled mid-stream — the wait rule
+    must not strand the queue."""
+    eng = _engine(params, max_slots=4, num_blocks=128, max_blocks_per_seq=16,
+                  prefill_buckets=(16,), max_prefills_per_step=4)
+    rng = np.random.default_rng(12)
+    prefix = list(rng.integers(3, 300, size=48))
+    p1 = prefix + list(rng.integers(3, 300, size=20))
+    p2 = prefix + list(rng.integers(3, 300, size=21))
+    eng.submit(GenerationRequest("c1", list(p1), SamplingParams(max_tokens=4)))
+    eng.submit(GenerationRequest("c2", list(p2), SamplingParams(max_tokens=4)))
+    eng.step()                 # admits c1 (streaming), defers c2
+    assert eng.prefix_deferrals == 1
+    eng.cancel("c1")
+    while eng.has_work:
+        eng.step()
+    r2 = eng.poll("c2")
+    assert r2 is not None and r2.finish_reason == "length"
+    assert r2.token_ids == _naive_greedy(params, p2, 4)
