@@ -401,6 +401,7 @@ def main() -> None:
     w8a8_p50_ms = w8a8_perchip_p50_ms = w8a8_shared_p50_ms = None
     w8a8_p99_ms = w8a8_perchip_p99_ms = None
     w8a8_shared_p99_ms = w8a8_decode_tok_s = None
+    cold_shared_p50_ms = cold_shared_p99_ms = None
     w8a8_wall = 0.0
     if quant == "int8" and os.environ.get("BENCH_W8A8", "1") == "1":
         aeng = None
@@ -470,6 +471,37 @@ def main() -> None:
             log(f"W8A8 shared-prefix: p50 TTFT {w8a8_shared_p50_ms:.1f} ms, "
                 f"p99 {w8a8_shared_p99_ms:.1f} ms "
                 f"at {n_requests} concurrent")
+
+            # COLD shared prefix: same shape, but the cache has never seen
+            # the prefix and nothing is pre-seeded — the first queries
+            # after a fresh snapshot.  Admission's cold-burst dedup
+            # (serving/engine.py _admit_round) must prefill the prefix
+            # once, not once per round-1 lane; every compiled program is
+            # already warm, so the delta vs the seeded leg above is pure
+            # scheduling.
+            pre_cold = list(rng.integers(4, cfg.vocab_size - 4,
+                                         size=shared_len))
+
+            def w8a8_cold() -> list[int]:
+                return pre_cold + list(rng.integers(
+                    4, cfg.vocab_size - 4, size=prompt_len - shared_len))
+            defer0 = aeng.prefix_deferrals
+            miss0 = aeng.prefix_cache.misses
+            for i in range(n_requests):
+                aeng.submit(GenerationRequest(
+                    request_id=f"aqcold-{i}", prompt_ids=w8a8_cold(),
+                    sampling=SamplingParams(max_tokens=max_tokens)))
+            while aeng.has_work:
+                aeng.step()
+            acold = [aeng.poll(f"aqcold-{i}") for i in range(n_requests)]
+            assert all(r is not None and r.finish_reason != "error"
+                       for r in acold)
+            cold_shared_p50_ms, cold_shared_p99_ms = ttft_pcts(acold)
+            log(f"W8A8 COLD shared-prefix: p50 TTFT "
+                f"{cold_shared_p50_ms:.1f} ms, p99 "
+                f"{cold_shared_p99_ms:.1f} ms at {n_requests} concurrent "
+                f"[{aeng.prefix_deferrals - defer0} deferrals, "
+                f"{aeng.prefix_cache.misses - miss0} full-prefix misses]")
 
             # W8A8 fused-decode step rate at full lanes: the s8 x s8
             # matmul halves the compute term of the decode-step ridge
@@ -680,13 +712,16 @@ def main() -> None:
             # dispatch is speculative and emits only a few tokens, so an
             # 8-token warmup never compiles the fused K=8 program and its
             # multi-second (cache-)compile would land inside the measured
-            # window (observed as a phantom 2-6x "regression").  The first
-            # 8-lane batch covers the P=8 dense admission (and registers
-            # the prefix); the second covers the P=8 *chunked* admission
-            # the measured burst takes when its first prompt hits the
-            # prefix cache.
-            se.generate([sp_prompts[0]] * 8, SamplingParams(max_tokens=24))
-            se.generate([sp_prompts[0]] * 8, SamplingParams(max_tokens=24))
+            # window (observed as a phantom 2-6x "regression").  Warmup
+            # prompts are DISTINCT (an identical batch would trip the
+            # cold-burst dedup and admit P=1, leaving the P=8 dense
+            # program cold) and disjoint from the measured burst (so the
+            # burst itself runs all-miss dense rounds).  The second call
+            # re-sends one registered prompt to warm the P=8 *chunked*
+            # hit-path admission.
+            warm_prompts = [prompt() for _ in range(8)]
+            se.generate(warm_prompts, SamplingParams(max_tokens=24))
+            se.generate([warm_prompts[0]] * 8, SamplingParams(max_tokens=24))
             spt0 = time.monotonic()
             for i, p in enumerate(sp_prompts):
                 se.submit(GenerationRequest(
@@ -958,6 +993,11 @@ def main() -> None:
         extras["w8a8_shared_prefix_p50_ttft_ms"] = round(w8a8_shared_p50_ms, 2)
         extras["w8a8_shared_prefix_p99_ttft_ms"] = round(
             w8a8_shared_p99_ms, 2)
+    if cold_shared_p50_ms is not None:
+        extras["w8a8_cold_shared_prefix_p50_ttft_ms"] = round(
+            cold_shared_p50_ms, 2)
+        extras["w8a8_cold_shared_prefix_p99_ttft_ms"] = round(
+            cold_shared_p99_ms, 2)
     if spec_tok_s is not None:
         extras["spec_decode_tok_s"] = round(spec_tok_s, 1)
         extras["spec_baseline_tok_s"] = round(spec_base_tok_s, 1)
