@@ -344,3 +344,27 @@ def test_publisher_cancel_releases_waiting_candidate(params):
     r2 = eng.poll("c2")
     assert r2 is not None and r2.finish_reason == "length"
     assert r2.token_ids == _naive_greedy(params, p2, 4)
+
+
+def test_defer_budget_bounds_round_scan(params):
+    """A cold same-prefix queue deeper than the per-round deferral budget
+    (4 x max_prefills_per_step) stops the admission scan at the budget —
+    the overflow stays pending, hits the cache next round, and the prefix
+    is still prefilled exactly once."""
+    eng = _engine(params, max_slots=16, num_blocks=256,
+                  max_prefills_per_step=2)   # defer budget = 8
+    rng = np.random.default_rng(13)
+    prefix = list(rng.integers(3, 300, size=24))
+    prompts = [prefix + list(rng.integers(3, 300, size=4))
+               for _ in range(12)]
+    for i, p in enumerate(prompts):
+        eng.submit(GenerationRequest(f"d{i}", list(p),
+                                     SamplingParams(max_tokens=3)))
+    while eng.has_work:
+        eng.step()
+    assert eng.prefix_cache.misses == 1     # one publisher, ever
+    assert eng.prefix_deferrals == 8        # capped at the round budget
+    for i, p in enumerate(prompts):
+        res = eng.poll(f"d{i}")
+        assert res is not None
+        assert res.token_ids == _naive_greedy(params, p, 3)
