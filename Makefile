@@ -5,7 +5,8 @@ PY ?= python
 TEST_ENV = env PYTHONPATH= JAX_PLATFORMS=cpu
 
 .PHONY: run run-agent run-scheduler demo test test-fast bench dryrun \
-        smoke deploy-agent docker docker-agent docker-scheduler lint clean
+        smoke preflight deploy-agent docker docker-agent docker-scheduler \
+        lint clean
 
 run:
 	$(PY) -m k8s_llm_monitor_tpu.cmd.server --cluster fake --port 8081
@@ -34,6 +35,10 @@ bench:
 
 smoke:              # boot server + 20-check live API suite
 	$(TEST_ENV) bash scripts/smoke.sh
+
+preflight:          # will the model/quant/mesh fit? (no weights built)
+	$(PY) -m k8s_llm_monitor_tpu.cmd.preflight --model llama3-8b \
+	  --quantize w8a8 --mesh 1,1,8 --kv-blocks 2200 --per-chip-hbm-gib 16
 
 deploy-agent:       # build agent image, k3d import, roll out DaemonSet
 	bash scripts/build-and-deploy-uav-agent.sh

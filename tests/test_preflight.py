@@ -1,0 +1,86 @@
+"""cmd.preflight: capacity/mesh preflight math against known geometries.
+
+All checks run via jax.eval_shape — no weights are materialized, so even
+70B-class configs preflight in seconds on the CPU test mesh.  (The
+reference's preflight surface is cluster-only, cmd/test-k8s/main.go; the
+TPU plane is this system's addition.)
+"""
+
+from k8s_llm_monitor_tpu.cmd.preflight import main
+
+
+def test_8b_w8a8_tp8_fits_v5e(capsys):
+    rc = main(["--model", "llama3-8b", "--quantize", "w8a8",
+               "--mesh", "1,1,8", "--per-chip-hbm-gib", "16",
+               "--kv-blocks", "2200"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "w8a8 weights 7.49 GiB total" in out   # matches the measured chip
+    assert "kv_heads=8 shard 8-way" in out
+    assert "preflight: PASS" in out
+
+
+def test_70b_bf16_single_chip_fails(capsys):
+    rc = main(["--model", "llama3-70b", "--quantize", "none",
+               "--mesh", "1,1,1", "--per-chip-hbm-gib", "16"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "does not fit" in out
+
+
+def test_70b_int8_tp16_fits_v5p(capsys):
+    rc = main(["--model", "llama3-70b", "--quantize", "int8",
+               "--mesh", "1,1,16", "--per-chip-hbm-gib", "95"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "GiB/chip at TP-16" in out
+
+
+def test_indivisible_tp_fails(capsys):
+    rc = main(["--model", "llama3-8b", "--mesh", "1,1,3"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "not divisible by model=3" in out
+    assert "KV pages replicate" in out            # warn, not fail
+
+
+def test_moe_estimated_bytes(capsys):
+    rc = main(["--model", "mixtral-8x7b", "--quantize", "int8",
+               "--mesh", "1,1,4", "--per-chip-hbm-gib", "95"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "estimated" in out
+    assert "experts=8" in out
+
+
+def test_kv_capacity_too_small_fails(capsys):
+    rc = main(["--model", "llama3-8b", "--quantize", "int8",
+               "--mesh", "1,1,1", "--per-chip-hbm-gib", "16",
+               "--kv-blocks", "8", "--prompt-len", "192",
+               "--max-tokens", "256"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "raise --kv-blocks" in out
+
+
+def test_cli_flags_beat_config(tmp_path, capsys):
+    """--config fills only unset flags; an explicit flag wins over YAML."""
+    cfg = tmp_path / "server.yaml"
+    cfg.write_text(
+        "llm:\n  tpu:\n    model: llama3-70b\n    quantize: int8\n"
+        "    mesh_shape: \"1,1,1\"\n    kv_blocks: 64\n")
+    rc = main(["--config", str(cfg), "--model", "llama3-8b",
+               "--mesh", "1,1,8", "--per-chip-hbm-gib", "16",
+               "--kv-blocks", "2200"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "heads=32/8kv" in out            # 8B geometry, not 70B's 64/8
+    assert "2200 blocks" in out             # CLI kv-blocks, not YAML's 64
+    assert "int8 weights" in out            # quantize came from the YAML
+
+
+def test_zero_mesh_dim_fails_cleanly(capsys):
+    rc = main(["--model", "llama3-8b", "--mesh", "1,1,0"])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "bad --mesh" in out
