@@ -286,3 +286,65 @@ def test_stress_seq_parallel_mesh_long_prompts(params, cpu_mesh_devices):
         assert sq[rid].token_ids == plain[rid].token_ids, rid
     sq_eng.prefix_cache.clear()
     assert sq_eng.allocator.free_blocks == 56 - 1
+
+
+@pytest.mark.parametrize("spec_k", [0, 4])
+def test_stress_cold_burst_deferral_under_churn(params, spec_k):
+    """The round-5 cold-burst dedup under randomized load: every wave
+    submits a burst sharing a brand-new (never-cached) prefix — short
+    dense publishers and chunk-streaming long publishers both — while a
+    tiny pool forces preemptions and random cancels kill publishers that
+    deferred candidates are waiting on.  Must drain with no drops, no
+    deadlock, no leaked blocks, and the deferral machinery must have
+    actually fired."""
+    eng = InferenceEngine(
+        CFG, params,
+        EngineConfig(max_slots=4, num_blocks=56, block_size=4,
+                     max_blocks_per_seq=32, prefill_buckets=(8, 16),
+                     max_prefills_per_step=4, max_admission_rounds=2,
+                     decode_steps_per_iter=4, max_inflight=2,
+                     decode_every_n_chunk_rounds=2,
+                     spec_k=spec_k, spec_rounds_per_iter=2),
+        eos_id=7,
+    )
+    rng = np.random.default_rng(23)
+    ids, cancelled = [], set()
+    steps = 0
+    for wave in range(8):
+        # A fresh prefix every wave: the cache has never seen it, so the
+        # wave's same-prefix burst exercises the deferral rules, not the
+        # warm hit path.  Odd waves use a long prefix so the publisher
+        # streams chunks (the bounded-wait rule); even waves stay dense.
+        plen = int(rng.integers(24, 44)) if wave % 2 else int(
+            rng.integers(12, 20))
+        prefix = list(rng.integers(8, 300, size=plen))
+        for j in range(4):
+            rid = f"c{wave}-{j}"
+            ids.append(rid)
+            tail = list(rng.integers(8, 300, size=int(rng.integers(1, 8))))
+            eng.submit(GenerationRequest(
+                rid, prefix + tail,
+                SamplingParams(max_tokens=int(rng.integers(1, 8)))))
+        for _ in range(int(rng.integers(1, 4))):
+            if eng.has_work:
+                eng.step()
+                steps += 1
+        # Kill a random in-flight request — sometimes the publisher a
+        # deferred candidate is waiting on.
+        victim = ids[int(rng.integers(max(0, len(ids) - 8), len(ids)))]
+        if eng.cancel(victim):
+            cancelled.add(victim)
+    while eng.has_work:
+        eng.step()
+        steps += 1
+        assert steps < 5_000
+    for rid in ids:
+        r = eng.poll(rid)
+        assert r is not None, f"{rid} dropped"
+        if rid in cancelled and r.finish_reason == "error":
+            continue
+        assert r.finish_reason in ("eos", "length"), (rid, r)
+    assert eng.prefix_deferrals > 0            # the dedup actually fired
+    assert eng.prefix_cache.hits > 0
+    eng.prefix_cache.clear()
+    assert eng.allocator.free_blocks == 56 - 1  # no leaked blocks
