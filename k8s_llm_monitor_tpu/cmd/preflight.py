@@ -50,19 +50,26 @@ _DTYPE_BYTES = {"bfloat16": 2, "float32": 4, "float16": 2,
 
 
 class _Report:
+    """Collects verdicts as structured lists (consumed by ``check()``)
+    while printing the human report."""
+
     def __init__(self) -> None:
         self.failed = 0
         self.warned = 0
+        self.fail_msgs: list[str] = []
+        self.warn_msgs: list[str] = []
 
     def ok(self, msg: str) -> None:
         print(f"  PASS {msg}")
 
     def warn(self, msg: str) -> None:
         self.warned += 1
+        self.warn_msgs.append(msg)
         print(f"  WARN {msg}")
 
     def fail(self, msg: str) -> None:
         self.failed += 1
+        self.fail_msgs.append(msg)
         print(f"  FAIL {msg}")
 
 
@@ -89,14 +96,15 @@ def _tree_bytes(shapes, specs, model_axis: int,
     return total, per_chip
 
 
-def run_preflight(args: argparse.Namespace) -> int:
+def run_preflight(args: argparse.Namespace,
+                  r: _Report | None = None) -> int:
     import jax
 
     from k8s_llm_monitor_tpu.models import llama
     from k8s_llm_monitor_tpu.models.config import PRESETS
     from k8s_llm_monitor_tpu.parallel.sharding import param_partition_specs
 
-    r = _Report()
+    r = r if r is not None else _Report()
 
     def finish() -> int:
         # Single verdict trailer — printed on early bail-outs too, so
@@ -291,7 +299,7 @@ def run_preflight(args: argparse.Namespace) -> int:
     return finish()
 
 
-def main(argv: list[str] | None = None) -> int:
+def _build_args(argv: list[str] | None) -> argparse.Namespace:
     ap = argparse.ArgumentParser(
         description="TPU serving preflight (no weights materialized)")
     ap.add_argument("--config", default="",
@@ -329,14 +337,29 @@ def main(argv: list[str] | None = None) -> int:
         if args.kv_blocks is None:
             args.kv_blocks = c.llm.tpu.kv_blocks or None
     # Hard defaults for anything neither flag nor config set.
-    args.model = args.model or "llama3-8b"
+    if args.model is None:
+        args.model = "llama3-8b"
     args.checkpoint = args.checkpoint or ""
     args.quantize = args.quantize if args.quantize is not None else "w8a8"
     if args.quantize == "none":
         args.quantize = ""
     args.mesh = args.mesh or "1,1,1"
     args.kv_blocks = args.kv_blocks or 512
-    return run_preflight(args)
+    return args
+
+
+def check(argv: list[str] | None = None) -> tuple[int, list[str], list[str]]:
+    """Programmatic preflight: (exit_code, fail_msgs, warn_msgs).
+
+    Same argv surface as the CLI; callers (monitor/analysis.py boot)
+    consume the structured lists instead of scraping printed output."""
+    r = _Report()
+    rc = run_preflight(_build_args(argv), r)
+    return rc, r.fail_msgs, r.warn_msgs
+
+
+def main(argv: list[str] | None = None) -> int:
+    return run_preflight(_build_args(argv))
 
 
 if __name__ == "__main__":

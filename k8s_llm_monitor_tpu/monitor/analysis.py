@@ -135,14 +135,64 @@ class LocalEngineBackend(LLMBackend):
         dev weights for the named preset."""
         import jax
 
+        # One normalization for the preflight AND the engine build below:
+        # 'int8'/'w8a8' are real modes, anything else is bf16.
+        qmode = getattr(tpu_cfg, "quantize", "")
+        quantize = qmode in ("int8", "w8a8")
+
+        # Fit preflight (cmd/preflight): shapes-only, so it warns about an
+        # over-budget config BEFORE the multi-GiB weight build OOMs the
+        # chip mid-load.  Warn-only — boot proceeds regardless.
+        try:
+            import contextlib
+            import io
+
+            from k8s_llm_monitor_tpu.cmd.preflight import check as _preflight
+
+            # --quantize is always passed (preflight's own default is
+            # w8a8, which would size int8 weights for a bf16 config —
+            # exactly the over-budget case this warning exists for).
+            # The workload shape mirrors what the engine can actually
+            # hold per sequence (EngineConfig default max_blocks_per_seq
+            # 64 x block 16 = 1024 tokens; longer requests are truncated
+            # at submit), so FAIL here means "cannot serve even one
+            # engine-shaped request".
+            argv = ["--kv-blocks", str(tpu_cfg.kv_blocks),
+                    "--quantize", qmode if quantize else "none",
+                    "--prompt-len", "768", "--max-tokens", "256"]
+            if tpu_cfg.checkpoint:
+                argv += ["--checkpoint", tpu_cfg.checkpoint]
+            else:
+                argv += ["--model", tpu_cfg.model]
+            if tpu_cfg.mesh_shape:
+                argv += ["--mesh", tpu_cfg.mesh_shape]
+            buf = io.StringIO()
+            with contextlib.redirect_stdout(buf), \
+                    contextlib.redirect_stderr(buf):
+                rc, fails, warns = _preflight(argv)
+            if warns:
+                # Context even when a FAIL follows (e.g. "fit checks
+                # skipped" qualifies what the verdict did NOT cover).
+                logger.info("TPU config preflight warnings: %s",
+                            "; ".join(warns))
+            if rc != 0:
+                logger.warning(
+                    "TPU config preflight FAILED (boot continues): %s — "
+                    "run `python -m k8s_llm_monitor_tpu.cmd.preflight` "
+                    "for the full report", "; ".join(fails) or "see report")
+        # SystemExit included: argparse exits on bad flag values, and
+        # preflight must never block boot.  The debug line keeps a broken
+        # preflight observable instead of silently disabling the check.
+        except (Exception, SystemExit) as exc:  # noqa: BLE001
+            logger.debug("TPU config preflight skipped: %s", exc,
+                         exc_info=True)
+
         from k8s_llm_monitor_tpu.models import llama
         from k8s_llm_monitor_tpu.models.config import PRESETS
         from k8s_llm_monitor_tpu.serving.engine import EngineConfig, InferenceEngine
         from k8s_llm_monitor_tpu.utils.tokenizer import load_tokenizer
 
         dev_weights = not tpu_cfg.checkpoint
-        qmode = getattr(tpu_cfg, "quantize", "")
-        quantize = qmode in ("int8", "w8a8")
         if tpu_cfg.checkpoint:
             from k8s_llm_monitor_tpu.utils.checkpoint import load_hf_checkpoint
 

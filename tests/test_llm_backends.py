@@ -107,3 +107,46 @@ def test_non_json_200_is_retried(stub):
     _StubLLM.fail_times = 1
     out = _backend(stub).generate("q")
     assert out == "ok" and _StubLLM.calls == 2
+
+
+def test_tpu_backend_boot_preflight_warns_on_unfittable_config(caplog):
+    """An over-budget llm.tpu config logs the preflight verdict at boot
+    (before the weight build could OOM a real chip) and still boots —
+    warn-only by contract."""
+    import logging
+
+    from k8s_llm_monitor_tpu.monitor.analysis import LocalEngineBackend
+    from k8s_llm_monitor_tpu.monitor.config import TPULLMConfig
+
+    cfg = TPULLMConfig(model="tiny", quantize="", kv_blocks=8)
+    with caplog.at_level(logging.WARNING):
+        backend = LocalEngineBackend.from_config(cfg)
+    try:
+        assert any("preflight FAILED" in m for m in caplog.messages)
+        assert any("raise --kv-blocks" in m for m in caplog.messages)
+        assert backend.engine is not None  # boot proceeded regardless
+    finally:
+        backend.service.stop()
+
+
+def test_tpu_backend_boot_preflight_tolerates_bogus_quantize(caplog):
+    """An unknown llm.tpu.quantize value must neither crash boot (argparse
+    SystemExit is contained) nor silently size the wrong dtype: it maps to
+    bf16 exactly like the engine build does."""
+    import logging
+
+    from k8s_llm_monitor_tpu.monitor.analysis import LocalEngineBackend
+    from k8s_llm_monitor_tpu.monitor.config import TPULLMConfig
+
+    cfg = TPULLMConfig(model="tiny", quantize="fp8-bogus", kv_blocks=8)
+    with caplog.at_level(logging.WARNING):
+        backend = LocalEngineBackend.from_config(cfg)
+    try:
+        assert any("preflight FAILED" in m for m in caplog.messages)
+        # bf16 engine (unknown quantize falls back, matching from_config)
+        import jax.numpy as jnp
+
+        q0 = backend.engine.params["layers"][0]["q"]
+        assert "kernel_q" not in q0 and q0["kernel"].dtype == jnp.bfloat16
+    finally:
+        backend.service.stop()
