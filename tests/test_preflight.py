@@ -84,3 +84,17 @@ def test_zero_mesh_dim_fails_cleanly(capsys):
     out = capsys.readouterr().out
     assert rc == 1
     assert "bad --mesh" in out
+
+
+def test_check_returns_structured_lists(capsys):
+    """check() (the boot-path API) returns (rc, fail_msgs, warn_msgs) as
+    structured lists — monitor/analysis.py consumes these, not scraped
+    stdout."""
+    rc, fails, warns = __import__(
+        "k8s_llm_monitor_tpu.cmd.preflight", fromlist=["check"]).check(
+        ["--model", "llama3-8b", "--quantize", "none",
+         "--mesh", "1,1,1", "--per-chip-hbm-gib", "16"])
+    capsys.readouterr()               # discard the printed human report
+    assert rc == 1
+    assert any("does not fit" in m for m in fails)
+    assert all(isinstance(m, str) for m in fails + warns)
