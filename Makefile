@@ -3,10 +3,11 @@
 
 PY ?= python
 TEST_ENV = env PYTHONPATH= JAX_PLATFORMS=cpu
+SHELL := /bin/bash    # tier1 uses pipefail/PIPESTATUS
 
-.PHONY: run run-agent run-scheduler demo test test-fast bench dryrun \
-        smoke preflight deploy-agent docker docker-agent docker-scheduler \
-        lint clean
+.PHONY: run run-agent run-scheduler demo test test-fast tier1 bench \
+        bench-decode dryrun smoke preflight deploy-agent docker \
+        docker-agent docker-scheduler lint clean
 
 run:
 	$(PY) -m k8s_llm_monitor_tpu.cmd.server --cluster fake --port 8081
@@ -30,8 +31,21 @@ test-fast:          # monitor plane only (no jax compiles)
 	  --ignore=tests/test_sharding.py \
 	  --ignore=tests/test_real_artifact_e2e.py
 
+tier1:              # the driver's verify gate, verbatim (ROADMAP.md)
+	set -o pipefail; rm -f /tmp/_t1.log; \
+	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
+	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
+	  -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; \
+	rc=$${PIPESTATUS[0]}; \
+	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log \
+	  | tr -cd . | wc -c); \
+	exit $$rc
+
 bench:
 	$(PY) bench.py
+
+bench-decode:       # fused-vs-fallback decode microbench + phase attribution
+	env BENCH_CONCURRENCY=8 BENCH_MAX_TOKENS=16 $(PY) bench.py
 
 smoke:              # boot server + 20-check live API suite
 	$(TEST_ENV) bash scripts/smoke.sh

@@ -357,6 +357,88 @@ def main() -> None:
         decode_step_ms = decode_stream_ms = decode_matmul_ms = None
         log(f"utilization legs skipped: {exc}")
 
+    # --- fused-vs-fallback decode micro-leg: the Pallas fused kernel
+    # (in-kernel RoPE + KV append + paged attention) against the XLA
+    # gather path, same K-step greedy scan, same synthetic state.  Greedy
+    # token streams must match — the fallback is the fused kernel's
+    # numerics oracle.  TPU-only: interpret-mode Pallas inside a scan is
+    # pathological on CPU and would time the emulator, not the kernel. ---
+    fused_decode_step_ms = fallback_decode_step_ms = None
+    fused_match = None
+    try:
+        import jax.numpy as jnp
+
+        if dev.platform != "tpu":
+            raise RuntimeError(f"needs TPU (platform={dev.platform})")
+        from k8s_llm_monitor_tpu.ops.attention import select_decode_impl
+
+        impls = {
+            "fallback": select_decode_impl(cfg=cfg, mode="gather"),
+            "fused": select_decode_impl(cfg=cfg, mode="fused"),
+        }
+        K = ecfg.decode_steps_per_iter
+        B = ecfg.max_slots
+
+        def _make_prog(impl):
+            def fn(params, tok_state, ctx, pages, tables):
+                def body(carry, _):
+                    tokens, c, pages = carry
+                    logits, pages = llama.decode_step(
+                        params, cfg, tokens, c, pages, tables,
+                        attn_impl=impl)
+                    nxt = jnp.argmax(logits, -1).astype(jnp.int32)
+                    return (nxt, c + 1, pages), nxt
+                (tok_state, _, pages), toks = jax.lax.scan(
+                    body, (tok_state, ctx, pages),
+                    jnp.arange(K, dtype=jnp.int32))
+                return toks, tok_state, pages
+            return jax.jit(fn, donate_argnums=(3,))
+
+        ctx = jnp.full((B,), prompt_len, jnp.int32)
+        dtbl = jnp.asarray(np.tile(np.asarray(tbl)[:1], (B, 1)))
+        streams = {}
+        times = {}
+        reps = 3
+        for name, impl in impls.items():
+            prog = _make_prog(impl)
+            tok_state = jnp.zeros((B,), jnp.int32)
+            toks, tok_state, eng.pages = prog(
+                params, tok_state, ctx, eng.pages, dtbl)
+            streams[name] = np.asarray(toks)
+            ft0 = time.monotonic()
+            for _ in range(reps):
+                _, tok_state, eng.pages = prog(
+                    params, jnp.zeros((B,), jnp.int32), ctx,
+                    eng.pages, dtbl)
+            tok_state.block_until_ready()
+            times[name] = (time.monotonic() - ft0) / (reps * K) * 1e3
+        fused_decode_step_ms = times["fused"]
+        fallback_decode_step_ms = times["fallback"]
+        fused_match = bool(
+            np.array_equal(streams["fused"], streams["fallback"]))
+        log(f"fused decode kernel: {fused_decode_step_ms:.2f} ms/step vs "
+            f"gather fallback {fallback_decode_step_ms:.2f} ms/step "
+            f"({fallback_decode_step_ms / max(fused_decode_step_ms, 1e-9):.2f}x)"
+            f" | greedy streams identical: {fused_match}")
+    except Exception as exc:  # noqa: BLE001 — extras never fail the bench
+        log(f"fused-vs-fallback leg skipped: {exc}")
+
+    # --- decode phase attribution: attention vs sampling share of the
+    # step, measured on the engine's own warm programs; populates the
+    # decode_attn_ms / decode_sample_ms exporter gauges. ----------------
+    decode_phases = None
+    try:
+        decode_phases = eng.profile_decode_phases()
+        log(f"decode phases: attn {decode_phases['decode_attn_ms']:.2f} ms"
+            f" + sample {decode_phases['decode_sample_ms']:.2f} ms of "
+            f"{decode_phases['decode_step_ms_long_ctx']:.2f} ms/step "
+            f"(long-ctx)")
+    except Exception as exc:  # noqa: BLE001 — extras never fail the bench
+        log(f"decode phase attribution skipped: {exc}")
+    # Captured now: the headline engine is deleted before extras assembly.
+    decode_path = eng.decode_path
+    decode_host_gap_ms = eng.decode_host_gap_ms
+
     # --- E2E 128-lane decode saturation: short prompts, generations that
     # fill each lane's KV capacity, all max_slots lanes live — the engine
     # (scheduler + reconcile + fused dispatch) at the lane count the
@@ -965,6 +1047,16 @@ def main() -> None:
             extras["decode_attribution"] = (
                 "compute/bandwidth ridge at this lane count: weight "
                 "streaming + B-scaled matmul each ~10ms; not HBM-bound")
+    extras["decode_path"] = decode_path
+    if fused_decode_step_ms is not None:
+        extras["fused_decode_step_ms"] = round(fused_decode_step_ms, 2)
+        extras["fallback_decode_step_ms"] = round(fallback_decode_step_ms, 2)
+        extras["fused_matches_fallback"] = fused_match
+    if decode_phases is not None:
+        extras["decode_attn_ms"] = round(decode_phases["decode_attn_ms"], 2)
+        extras["decode_sample_ms"] = round(
+            decode_phases["decode_sample_ms"], 2)
+        extras["decode_host_gap_ms"] = round(decode_host_gap_ms, 2)
     if dec_e2e_tok_s is not None:
         extras["decode_e2e_128lane_tok_s"] = round(dec_e2e_tok_s, 1)
     if w8a8_decode_tok_s is not None:

@@ -214,17 +214,35 @@ def _embed_lookup(params: Params, cfg: ModelConfig,
     return rows
 
 
-def _qkv(layer: Params, cfg: ModelConfig, x: jnp.ndarray, cos, sin):
-    """Project + rope.  x: [B, S, H] -> q [B,S,nH,D], k/v [B,S,nKV,D]."""
+def _qkv_proj(layer: Params, cfg: ModelConfig, x: jnp.ndarray):
+    """Projections only (no rope).  x: [B, S, H] -> q [B,S,nH,D],
+    k/v [B,S,nKV,D].  The fused decode kernel takes these raw and applies
+    rope in-kernel; every other path ropes via ``_qkv``."""
     B, S, _ = x.shape
     D = cfg.head_dim_
     aq = cfg.act_quant
     q = _linear(layer["q"], x, aq).reshape(B, S, cfg.num_heads, D)
     k = _linear(layer["k"], x, aq).reshape(B, S, cfg.num_kv_heads, D)
     v = _linear(layer["v"], x, aq).reshape(B, S, cfg.num_kv_heads, D)
+    return q, k, v
+
+
+def _qkv(layer: Params, cfg: ModelConfig, x: jnp.ndarray, cos, sin):
+    """Project + rope.  x: [B, S, H] -> q [B,S,nH,D], k/v [B,S,nKV,D]."""
+    q, k, v = _qkv_proj(layer, cfg, x)
     q = apply_rope(q, cos, sin)
     k = apply_rope(k, cos, sin)
     return q, k, v
+
+
+def is_fused_decode_impl(attn_impl) -> bool:
+    """True when ``attn_impl`` uses the fused decode calling convention
+    (ops/pallas_attention.py:paged_decode_attention_fused — raw q/k/v +
+    rope angles in, attention + updated pages out).  Survives a
+    functools.partial wrap (tests bind interpret=True that way)."""
+    return bool(getattr(attn_impl, "fused_decode", False)
+                or getattr(getattr(attn_impl, "func", None),
+                           "fused_decode", False))
 
 
 def _expert_weights(p: Params, dtype, act_quant: bool = False):
@@ -810,6 +828,7 @@ def decode_step(
     active = (context_lens > 0)[:, None]
     cos, sin = rope_angles(positions, cfg.head_dim_, cfg.rope_theta,
                            scaling=cfg.rope_scaling)
+    fused = is_fused_decode_impl(attn_impl)
 
     x = _embed_lookup(params, cfg, tokens)[:, None, :]  # [B, 1, H]
     uo = cfg.rmsnorm_unit_offset
@@ -817,16 +836,31 @@ def decode_step(
     new_k, new_v = [], []
     for li, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps, uo)
-        q, k, v = _qkv(layer, cfg, h, cos, sin)
-        pk = _scatter_pages(pages.k[li], k, block_tables, positions, active)
-        pv = _scatter_pages(pages.v[li], v, block_tables, positions, active)
-        new_k.append(pk)
-        new_v.append(pv)
-        # Extras models are guaranteed the gather impl (select_attn_impl),
-        # which accepts the per-layer kwargs; default models pass none so
-        # custom/Pallas impls keep their fixed signature.
-        attn = attn_impl(q, pk, pv, block_tables, new_lens,
-                         **_attn_extras(cfg, li))
+        if fused:
+            # Fused fast-path: rope + KV append + attention in one Pallas
+            # call; the kernel owns the scatter (in-place page update) and
+            # the query/new-k rotary math.  Extras models never select
+            # this path (ops/attention.py gates on has_attn_extras).
+            q, k, v = _qkv_proj(layer, cfg, h)
+            attn, pk, pv = attn_impl(q, k, v, cos, sin,
+                                     pages.k[li], pages.v[li],
+                                     block_tables, context_lens)
+            new_k.append(pk)
+            new_v.append(pv)
+        else:
+            q, k, v = _qkv(layer, cfg, h, cos, sin)
+            pk = _scatter_pages(pages.k[li], k, block_tables, positions,
+                                active)
+            pv = _scatter_pages(pages.v[li], v, block_tables, positions,
+                                active)
+            new_k.append(pk)
+            new_v.append(pv)
+            # Extras models are guaranteed the gather impl
+            # (select_attn_impl), which accepts the per-layer kwargs;
+            # default models pass none so custom/Pallas impls keep their
+            # fixed signature.
+            attn = attn_impl(q, pk, pv, block_tables, new_lens,
+                             **_attn_extras(cfg, li))
         o = _linear(layer["o"], attn.reshape(B, 1, -1), cfg.act_quant)
         x, _ = _residual_tail(layer, cfg, x, o)
 

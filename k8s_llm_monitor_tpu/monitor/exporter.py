@@ -93,6 +93,27 @@ def _engine_metrics(w: _Writer, engine) -> None:
              "spec_tokens by this for per-lane acceptance)",
              [("", engine.spec_lane_rounds)])
 
+    # Decode-step phase attribution (fused fast-path observability).
+    # attn/sample are populated by engine.profile_decode_phases() — a
+    # bench/admin probe, never run on scrape — so they read 0.0 until a
+    # profile has run.  host_gap is a live EMA updated at every decode
+    # reconcile and is the one to alert on: it should sit near 0 when
+    # dispatch-ahead hides device latency.
+    path = getattr(engine, "decode_path", "unknown")
+    w.metric("engine_decode_path_info", "gauge",
+             "Selected decode attention path (1 = active)",
+             [(f'{{path="{path}"}}', 1)])
+    w.metric("engine_decode_attn_ms", "gauge",
+             "Profiled per-step paged-attention cost at long context",
+             [("", round(getattr(engine, "decode_attn_ms", 0.0), 4))])
+    w.metric("engine_decode_sample_ms", "gauge",
+             "Profiled per-step on-device sampling cost",
+             [("", round(getattr(engine, "decode_sample_ms", 0.0), 4))])
+    w.metric("engine_decode_host_gap_ms", "gauge",
+             "EMA of host time blocked per decode/spec reconcile "
+             "(~0 when dispatch-ahead hides device latency)",
+             [("", round(getattr(engine, "decode_host_gap_ms", 0.0), 4))])
+
     # Prometheus histogram: cumulative buckets + sum + count.
     cumulative = 0
     samples = []

@@ -387,3 +387,75 @@ def select_attn_impl(platform: str | None = None, cfg=None, mesh=None):
             "XLA gather fallback — O(B*max_ctx) HBM traffic per decode "
             "step", exc)
         return paged_decode_attention
+
+
+def select_decode_impl(platform: str | None = None, cfg=None, mesh=None,
+                       mode: str = "auto"):
+    """Pick the decode-step attention path, including the fused fast-path.
+
+    ``mode`` (EngineConfig.decode_path / K8SLLM_DECODE_PATH env):
+      * ``"auto"``   — the fused RoPE+append+attention kernel
+        (ops/pallas_attention.py:paged_decode_attention_fused) on a
+        single TPU chip when the model passes the geometry gate;
+        otherwise whatever ``select_attn_impl`` picks.
+      * ``"fused"``  — force the fused kernel (interpreter off-TPU; used
+        by parity tests and the bench's fused leg).  Raises if the model
+        can't take it (extras models, odd head_dim) rather than silently
+        falling back — the caller asked for a specific path.
+      * ``"gather"`` — force the XLA gather fallback (the numerics
+        oracle; also what the fused path is diffed against in tests).
+      * ``"pallas"`` — force the split kernel pipeline (Pallas attention
+        with the XLA rope/scatter around it).
+
+    Returns an attention impl for models/llama.py:decode_step; fused
+    impls are marked (``is_fused_decode_impl``) and use the extended
+    calling convention (raw q/k/v + angles in, pages out).
+    """
+    import functools
+    import logging
+
+    logger = logging.getLogger("k8s_llm_monitor_tpu.ops")
+    if platform is None:
+        platform = jax.default_backend()
+
+    def _fused_ok():
+        return (mesh is None
+                and cfg is not None
+                and not getattr(cfg, "has_attn_extras", False)
+                and cfg.head_dim_ % 2 == 0
+                and _pallas_geometry_ok(cfg, 1))
+
+    if mode == "gather":
+        return paged_decode_attention
+    if mode == "pallas":
+        return select_attn_impl(platform, cfg=cfg, mesh=mesh)
+    if mode == "fused":
+        if not _fused_ok():
+            raise ValueError(
+                "decode_path='fused' but the model/mesh can't take the "
+                "fused kernel (mesh, attn extras, odd head_dim, or lane "
+                "alignment); use decode_path='auto' for gated selection")
+        from k8s_llm_monitor_tpu.ops.pallas_attention import (
+            paged_decode_attention_fused,
+        )
+
+        if platform != "tpu":
+            return functools.partial(paged_decode_attention_fused,
+                                     interpret=True)
+        return paged_decode_attention_fused
+    if mode != "auto":
+        raise ValueError(f"unknown decode_path {mode!r}; expected "
+                         "'auto', 'fused', 'gather', or 'pallas'")
+
+    if platform == "tpu" and _fused_ok():
+        try:
+            from k8s_llm_monitor_tpu.ops.pallas_attention import (
+                paged_decode_attention_fused,
+            )
+
+            return paged_decode_attention_fused
+        except Exception as exc:  # pragma: no cover - import unavailable
+            logger.warning(
+                "fused decode kernel failed to import (%s); using the "
+                "split path", exc)
+    return select_attn_impl(platform, cfg=cfg, mesh=mesh)

@@ -44,6 +44,10 @@ from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
 
+# jax renamed TPUCompilerParams -> CompilerParams; support both.
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")
+
 
 # Pages DMA'd per burst: W pages' copies are issued together and waited
 # once, so per-copy HBM latency overlaps within the burst instead of
@@ -231,7 +235,7 @@ def _run_paged_attn(q, k_pages, v_pages, block_table, starts, qlens,
         functools.partial(_paged_attn_kernel, QS, H),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, QS * H, F), q.dtype),
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             # Programs touch disjoint q/o tiles and only read pages: the
             # tile grid is safely parallel (megacore splits it).
             dimension_semantics=("parallel",),
@@ -278,6 +282,304 @@ def paged_decode_attention_pallas(
     qlens = jnp.minimum(lengths, 1).astype(jnp.int32)
     return _run_paged_attn(q, k_pages, v_pages, block_table, starts, qlens,
                            interpret)
+
+
+# ---------------------------------------------------------------------------
+# Fused decode fast-path: RoPE + KV append + paged attention in one kernel
+# ---------------------------------------------------------------------------
+
+
+def _rotate_half_fused(x, D):
+    """rotate_half within each D-slice of a fused-lane [..., KVH*D] array.
+
+    For lane j with r = j mod D: first half (r < D/2) takes -x[j + D/2],
+    second half takes x[j - D/2].  Both reads stay inside j's D-slice, so
+    two full-axis rolls + a half-mask select implement the per-slice
+    rotate without any lane-offset slicing (which Mosaic restricts).
+    """
+    F = x.shape[-1]
+    lane = jax.lax.broadcasted_iota(jnp.int32, (1, F), 1)
+    first_half = jax.lax.rem(lane, D) < (D // 2)
+    fwd = pltpu.roll(x, D // 2, 1)          # x[j - D/2]
+    bwd = pltpu.roll(x, (F - D // 2) % F, 1)  # x[j + D/2]
+    return jnp.where(first_half, -bwd, fwd)
+
+
+def _fused_decode_kernel(
+    H,                     # static: query heads per token
+    D,                     # static: head dim
+    # scalar prefetch
+    tables_ref,            # [B, NB] int32 block ids
+    pos_ref,               # [B] int32 new-token position (0 = inactive lane)
+    # inputs
+    q_ref,                 # [TB, H, F] raw (unroped) block-diagonal queries
+    kn_ref,                # [TB, 1, F] raw fused-lane new-token k
+    vn_ref,                # [TB, 1, F] fused-lane new-token v
+    cos_ref,               # [TB, 1, F] rope cos, tiled per kv group
+    sin_ref,               # [TB, 1, F]
+    k_hbm,                 # [num_blocks, bs, F] (ANY/HBM; aliased to k_out)
+    v_hbm,
+    # outputs
+    o_ref,                 # [TB, H, F]
+    k_out,                 # aliased page arrays (ANY/HBM)
+    v_out,
+):
+    """Decode step for TB sequences: RoPE the query and the new token's k
+    in-kernel, DMA the roped k / raw v row into its page (overlapped with
+    the attention math), stream the CACHED pages (positions < pos) with
+    online softmax, and fold the current token in as one extra softmax
+    update from VMEM — so the appended row is never read back from HBM
+    and the append DMA can land any time before the program ends.
+
+    Inactive lanes (pos == 0) stream nothing and write their row to the
+    null block 0, matching models/llama.py:_scatter_pages; their output is
+    finite garbage (only the current-token term) that the engine discards.
+    """
+    TB = q_ref.shape[0]
+    b0 = pl.program_id(0) * TB
+    bs = k_hbm.shape[1]
+    F = q_ref.shape[2]
+    NB = tables_ref.shape[1]
+    W = min(_WINDOW, NB)
+
+    def scoped(k_buf, v_buf, k_row, v_row, sem, append_sem):
+        def start_window(slot, b, w):
+            for i in range(W):
+                j = jnp.minimum(w * W + i, NB - 1)
+                blk = tables_ref[b, j]
+                pltpu.make_async_copy(
+                    k_hbm.at[blk], k_buf.at[slot, pl.ds(i * bs, bs)],
+                    sem.at[slot, i, 0]).start()
+                pltpu.make_async_copy(
+                    v_hbm.at[blk], v_buf.at[slot, pl.ds(i * bs, bs)],
+                    sem.at[slot, i, 1]).start()
+
+        def wait_window(slot, b, w):
+            for i in range(W):
+                j = jnp.minimum(w * W + i, NB - 1)
+                blk = tables_ref[b, j]
+                pltpu.make_async_copy(
+                    k_hbm.at[blk], k_buf.at[slot, pl.ds(i * bs, bs)],
+                    sem.at[slot, i, 0]).wait()
+                pltpu.make_async_copy(
+                    v_hbm.at[blk], v_buf.at[slot, pl.ds(i * bs, bs)],
+                    sem.at[slot, i, 1]).wait()
+
+        for t in range(TB):
+            b = b0 + t
+            pos = pos_ref[b]                 # tokens cached before this one
+            active = pos > 0
+
+            # --- in-kernel RoPE (f32, like ops/rope.py) -------------------
+            cos = cos_ref[t].astype(jnp.float32)          # [1, F]
+            sin = sin_ref[t].astype(jnp.float32)
+            q = q_ref[t].astype(jnp.float32)              # [H, F] block-diag
+            # Per-D-slice rotate: a head's slice-g support stays in slice
+            # g and zeros rope to zeros, so roping the block-diagonal
+            # matrix equals block-diagonalizing the roped heads.
+            qf = q * cos + _rotate_half_fused(q, D) * sin
+            kn = kn_ref[t].astype(jnp.float32)            # [1, F]
+            kf = kn * cos + _rotate_half_fused(kn, D) * sin
+
+            # --- KV append: start the DMA, overlap with attention ---------
+            raw_blk = pos // bs
+            in_table = raw_blk < NB
+            blk = jnp.where(active & in_table,
+                            tables_ref[b, jnp.minimum(raw_blk, NB - 1)], 0)
+            off = jax.lax.rem(pos, bs)
+            k_row[...] = kf.astype(k_row.dtype)
+            v_row[...] = vn_ref[t].astype(v_row.dtype)
+            k_copy = pltpu.make_async_copy(
+                k_row, k_out.at[blk, pl.ds(off, 1)], append_sem.at[0])
+            v_copy = pltpu.make_async_copy(
+                v_row, v_out.at[blk, pl.ds(off, 1)], append_sem.at[1])
+            k_copy.start()
+            v_copy.start()
+
+            # --- stream the cached pages (positions < pos) ----------------
+            n_blocks = (pos + bs - 1) // bs              # 0 for inactive
+            n_windows = (n_blocks + W - 1) // W
+
+            @pl.when(n_windows > 0)
+            def _first():
+                start_window(0, b, 0)
+
+            def body(w, carry, b=b, pos=pos, n_windows=n_windows):
+                m, l, acc = carry
+                slot = jax.lax.rem(w, 2)
+
+                @pl.when(w + 1 < n_windows)
+                def _prefetch():
+                    start_window(1 - slot, b, w + 1)
+
+                wait_window(slot, b, w)
+                p_idx = (w * (W * bs)
+                         + jax.lax.broadcasted_iota(jnp.int32, (1, W * bs), 1))
+                # The row being appended (p_idx == pos) is masked, so the
+                # in-flight append DMA can never race a row we consume.
+                valid = p_idx < pos
+                kblk = k_buf[slot].astype(jnp.float32)
+                vblk = v_buf[slot].astype(jnp.float32)
+                s = jax.lax.dot_general(
+                    qf, kblk, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                s = jnp.where(valid, s, NEG_INF)
+                m_cur = jnp.max(s, axis=-1, keepdims=True)
+                m_new = jnp.maximum(m, m_cur)
+                alpha = jnp.exp(m - m_new)
+                p = jnp.exp(s - m_new)
+                l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+                pv = jax.lax.dot_general(
+                    p, vblk, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                return m_new, l_new, alpha * acc + pv
+
+            m0 = jnp.full((H, 1), NEG_INF, jnp.float32)
+            l0 = jnp.zeros((H, 1), jnp.float32)
+            acc0 = jnp.zeros((H, F), jnp.float32)
+            m, l, acc = jax.lax.fori_loop(0, n_windows, body, (m0, l0, acc0))
+
+            # --- current token: one more online-softmax update from VMEM --
+            # Always included (even for inactive lanes) so l > 0 and the
+            # output stays finite without the cached window the old
+            # gather path borrowed from the null block.
+            s_cur = jax.lax.dot_general(
+                qf, kf, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)       # [H, 1]
+            m_new = jnp.maximum(m, s_cur)
+            alpha = jnp.exp(m - m_new)
+            p_cur = jnp.exp(s_cur - m_new)
+            l = alpha * l + p_cur
+            vf = vn_ref[t].astype(jnp.float32)            # [1, F]
+            acc = alpha * acc + p_cur * vf
+
+            k_copy.wait()
+            v_copy.wait()
+            o_ref[t] = (acc / l).astype(o_ref.dtype)
+
+    pl.run_scoped(
+        scoped,
+        k_buf=pltpu.VMEM((2, W * bs, F), k_hbm.dtype),
+        v_buf=pltpu.VMEM((2, W * bs, F), v_hbm.dtype),
+        k_row=pltpu.VMEM((1, F), k_hbm.dtype),
+        v_row=pltpu.VMEM((1, F), v_hbm.dtype),
+        sem=pltpu.SemaphoreType.DMA((2, W, 2)),
+        append_sem=pltpu.SemaphoreType.DMA((2,)),
+    )
+
+
+def paged_decode_attention_fused(
+    q: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    block_table: jnp.ndarray,
+    positions: jnp.ndarray,
+    *,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Fused decode step: RoPE + KV append + paged attention in one call.
+
+    Replaces the decode-path sequence apply_rope -> _scatter_pages ->
+    paged_decode_attention (models/llama.py) with a single Pallas kernel:
+    the query/new-k rotary embedding runs in-kernel, the new token's K/V
+    row is DMA'd into its page from VMEM (no XLA scatter over the full
+    page arrays), and attention streams only the CACHED pages, folding
+    the current token in from registers.  The page outputs alias the
+    inputs (in-place update) so the engine's donated KV buffers are
+    never copied.
+
+    Args:
+      q: [B, 1, H, D] raw (unroped) queries.
+      k_new, v_new: [B, 1, KVH, D] raw new-token projections (k unroped).
+      cos, sin: [B, 1, D] rope angle tables at each lane's position
+        (ops/rope.py:rope_angles of ``positions``).
+      k_pages, v_pages: [num_blocks, bs, KVH*D] resident page arrays.
+      block_table: [B, max_blocks_per_seq] int32 (0 = null block).
+      positions: [B] int32 — tokens already cached per lane, i.e. the new
+        token's absolute position; 0 marks an inactive lane whose write
+        is redirected to the null block (same as _scatter_pages).
+      interpret: run in the Pallas interpreter (CPU parity tests).
+
+    Returns:
+      (attn [B, 1, H, D], updated k_pages, updated v_pages).
+    """
+    B, S, H, D = q.shape
+    assert S == 1, f"fused decode kernel expects one query token, got {S}"
+    nblk, bs, F = k_pages.shape
+    assert F % D == 0 and D % 2 == 0 and D <= 128, (F, D)
+    KVH = F // D
+    q_per_kv = H // KVH
+
+    group = jnp.arange(H, dtype=jnp.int32) // q_per_kv
+    onehot = jax.nn.one_hot(group, KVH, dtype=q.dtype)
+    # Raw block-diagonal queries; RoPE commutes with the D**-0.5 scale and
+    # acts within each D-slice, so roping this matrix in-kernel is exact.
+    q_bd = (q[:, 0, :, None, :] * (D ** -0.5)
+            * onehot[None, :, :, None]).reshape(B, H, F)
+    kn = k_new.reshape(B, 1, F)
+    vn = v_new.reshape(B, 1, F)
+    cos_f = jnp.tile(cos.astype(jnp.float32), (1, 1, KVH))     # [B, 1, F]
+    sin_f = jnp.tile(sin.astype(jnp.float32), (1, 1, KVH))
+
+    budget = 4 * 2**20 // max(H * F * q.dtype.itemsize, 1)
+    TB = next(tb for tb in (8, 4, 2, 1)
+              if B % tb == 0 and (B // tb >= 2 or B == 1)
+              and (tb <= budget or tb == 1))
+    lane_spec = lambda p, tbl, pos: (p, 0, 0)  # noqa: E731
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B // TB,),
+        in_specs=[
+            pl.BlockSpec((TB, H, F), lane_spec),
+            pl.BlockSpec((TB, 1, F), lane_spec),
+            pl.BlockSpec((TB, 1, F), lane_spec),
+            pl.BlockSpec((TB, 1, F), lane_spec),
+            pl.BlockSpec((TB, 1, F), lane_spec),
+            pl.BlockSpec(memory_space=pl.ANY),   # K pages stay in HBM
+            pl.BlockSpec(memory_space=pl.ANY),   # V pages stay in HBM
+        ],
+        out_specs=[
+            pl.BlockSpec((TB, H, F), lane_spec),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+    )
+
+    out_full, k_out, v_out = pl.pallas_call(
+        functools.partial(_fused_decode_kernel, H, D),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, F), q.dtype),
+            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+        ],
+        # Page arrays update in place: inputs 7/8 (after the 2 scalar-
+        # prefetch operands) alias outputs 1/2.
+        input_output_aliases={7: 1, 8: 2},
+        compiler_params=_CompilerParams(
+            # Lanes append to blocks they own (the allocator hands out
+            # distinct tail blocks; only never-read null-block rows race),
+            # so the tile grid stays megacore-parallel like the decode
+            # kernel.
+            dimension_semantics=("parallel",),
+        ),
+        interpret=interpret,
+    )(block_table, positions.astype(jnp.int32), q_bd, kn, vn, cos_f, sin_f,
+      k_pages, v_pages)
+
+    out = jnp.take_along_axis(
+        out_full.reshape(B, 1, H, KVH, D),
+        group[None, None, :, None, None], axis=3)[:, :, :, 0, :]
+    return out, k_out, v_out
+
+
+# Marker consumed by models/llama.py:decode_step to select the fused
+# calling convention (raw q/k/v + angles in, pages out).
+paged_decode_attention_fused.fused_decode = True
 
 
 @functools.partial(jax.jit, static_argnames=("interpret",))

@@ -71,6 +71,56 @@ def filtered_scaled_logits(
     return jnp.where(keep, scaled, -jnp.inf)
 
 
+def sample_tokens_bounded(
+    rng: jax.Array,
+    logits: jnp.ndarray,
+    *,
+    temperature: jnp.ndarray,
+    top_k: jnp.ndarray,
+    top_p: jnp.ndarray,
+    k_cap: int,
+) -> jnp.ndarray:
+    """``sample_tokens`` restricted to the top ``k_cap`` logits per lane.
+
+    Samples the EXACT ``filtered_scaled_logits`` distribution whenever every
+    sampling lane has ``0 < top_k <= k_cap`` (the dispatcher checks this
+    before selecting the bounded program): top-k keeps at most ``k_cap``
+    tokens, and top-p here filters *within* the top-k distribution, so no
+    token outside the top ``k_cap`` can ever carry probability.  The win is
+    replacing the full-vocab descending argsort (V is 128k on the 8B
+    target — the sort dominates the on-device sampling cost inside the
+    fused decode scan) with one ``lax.top_k`` over ``k_cap`` lanes.
+
+    Ties resolve identically to the full path (lowest token id first, both
+    via stable ordering), but the categorical draw uses a [B, k_cap] gumbel
+    shape instead of [B, V] — same distribution, different stream for a
+    given key.  Greedy lanes (temperature <= 0) take the argmax exactly as
+    in ``sample_tokens``.
+    """
+    B, V = logits.shape
+    logits = logits.astype(jnp.float32)
+    greedy = greedy_tokens(logits)
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    scaled = logits / temp
+
+    vals, idx = jax.lax.top_k(scaled, k_cap)            # [B, k_cap], sorted
+    ranks = jnp.arange(k_cap, dtype=jnp.int32)[None, :]
+    k = jnp.clip(top_k, 1, k_cap)[:, None]
+    masked = jnp.where(ranks < k, vals, -jnp.inf)
+    probs = jax.nn.softmax(masked, axis=-1)
+    cum_before = jnp.cumsum(probs, axis=-1) - probs
+    n_keep = jnp.sum(cum_before < top_p[:, None], axis=-1, dtype=jnp.int32)
+    n_keep = jnp.where(top_p < 1.0,
+                       jnp.maximum(n_keep, 1), k_cap)[:, None]
+    keep = ranks < jnp.minimum(k, n_keep)
+    filtered = jnp.where(keep, masked, -jnp.inf)
+
+    choice = jax.random.categorical(rng, filtered, axis=-1)   # [B] < k_cap
+    sampled = jnp.take_along_axis(idx, choice[:, None], axis=-1)[:, 0]
+    return jnp.where(temperature <= 0.0, greedy,
+                     sampled.astype(jnp.int32))
+
+
 def sample_tokens(
     rng: jax.Array,
     logits: jnp.ndarray,

@@ -44,8 +44,18 @@ def init_multihost(coordinator: str | None = None,
 
     # Must not touch any API that initializes the XLA backend before
     # initialize() — jax.process_count() does, after which initialize()
-    # raises unconditionally.  is_initialized() only reads client state.
-    if jax.distributed.is_initialized():
+    # raises unconditionally.  Only read distributed-client state here
+    # (jax.distributed.is_initialized() where available, else the global
+    # state object older jax exposes).
+    is_init = getattr(jax.distributed, "is_initialized", None)
+    if is_init is None:
+        from jax._src import distributed as _dist
+
+        def is_init():
+            state = getattr(_dist, "global_state", None)
+            return state is not None and state.client is not None
+
+    if is_init():
         return jax.process_index()
     coordinator = coordinator or os.environ.get("JAX_COORDINATOR")
     num_processes = num_processes or int(os.environ.get("NUM_PROCESSES", 0))

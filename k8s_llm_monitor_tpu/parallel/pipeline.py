@@ -188,7 +188,10 @@ def make_pipeline_forward(mesh: Mesh, cfg: ModelConfig):
             # layers_local leaves: [1, Lp, ...] -> [Lp, ...]
             layers_local = jax.tree.map(lambda x: x[0], layers_local)
             s = jax.lax.axis_index("pipe")
-            P_ = jax.lax.axis_size("pipe")
+            # Static stage count from the mesh (jax.lax.axis_size only
+            # exists in newer jax; T below must be static for the scan
+            # length anyway).
+            P_ = mesh.shape["pipe"]
             M, mb, S, H = x0_local.shape
             T = M + P_ - 1
 

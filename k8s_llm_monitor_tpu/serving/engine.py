@@ -61,7 +61,11 @@ import numpy as np
 
 from k8s_llm_monitor_tpu.models import llama
 from k8s_llm_monitor_tpu.models.config import ModelConfig
-from k8s_llm_monitor_tpu.ops.sampling import greedy_tokens, sample_tokens
+from k8s_llm_monitor_tpu.ops.sampling import (
+    greedy_tokens,
+    sample_tokens,
+    sample_tokens_bounded,
+)
 from k8s_llm_monitor_tpu.serving.kv_cache import (
     BlockAllocator,
     OutOfBlocks,
@@ -127,6 +131,18 @@ class EngineConfig:
     decode_steps_per_iter: int = 8
     # Dispatch-ahead depth: calls in flight before reconciling the oldest.
     max_inflight: int = 2
+    # Decode attention path (ops/attention.py:select_decode_impl):
+    # "auto" = the fused RoPE+append+attention Pallas kernel on a
+    # compatible single TPU chip, split/gather otherwise; "fused",
+    # "pallas", "gather" force a path.  K8SLLM_DECODE_PATH overrides.
+    decode_path: str = "auto"
+    # On-device sampling: when every sampling lane of a dispatch has
+    # 0 < top_k <= this cap, the decode program samples from the top
+    # ``sample_topk_cap`` logits (one lax.top_k) instead of rank-sorting
+    # the full vocab each scan step (V=128k on the 8B target).  The
+    # bounded program is distribution-exact in that regime
+    # (ops/sampling.py:sample_tokens_bounded); 0 disables.
+    sample_topk_cap: int = 64
     # Prompt-prefix KV reuse (serving/kv_cache.py:PrefixCache): LRU entry
     # cap (one entry per cached prefix *length*; host-side tuples, cheap);
     # 0 disables.  Shared blocks are read-only by construction, so this is
@@ -310,13 +326,25 @@ class InferenceEngine:
         self.prefix_deferrals = 0
 
         if attn_impl is None:
-            from k8s_llm_monitor_tpu.ops.attention import select_attn_impl
-            # Under a GSPMD mesh the kernel runs per-shard via shard_map
+            import os
+
+            from k8s_llm_monitor_tpu.ops.attention import select_decode_impl
+            # Decode path: the fused RoPE+append+attention kernel on a
+            # compatible single TPU chip; under a GSPMD mesh the split
+            # kernel runs per-shard via shard_map
             # (ops/attention.py:make_tp_paged_attention) when the KV heads
             # divide the TP degree; otherwise the XLA gather path
             # partitions automatically.
-            attn_impl = select_attn_impl(cfg=cfg, mesh=mesh)
+            mode = os.environ.get("K8SLLM_DECODE_PATH", ec.decode_path)
+            attn_impl = select_decode_impl(cfg=cfg, mesh=mesh, mode=mode)
         self._attn_impl = attn_impl
+        # "fused" | "pallas" | "gather" — surfaced in /metrics and bench.
+        if llama.is_fused_decode_impl(attn_impl):
+            self.decode_path = "fused"
+        elif getattr(attn_impl, "__name__", "") == "paged_decode_attention":
+            self.decode_path = "gather"
+        else:
+            self.decode_path = "pallas"
         # Multi-query attention for the speculative verify pass (Pallas
         # kernel on compatible single-chip TPU; XLA gather otherwise).
         if self.ecfg.spec_k > 0:
@@ -425,6 +453,15 @@ class InferenceEngine:
         self.ttft_counts = [0] * (len(self.ttft_buckets) + 1)  # +Inf last
         self.ttft_sum = 0.0
         self.ttft_count = 0
+        # Decode phase attribution (monitor/exporter.py gauges).
+        # decode_host_gap_ms: EMA of host time blocked per decode/spec
+        # reconcile — ~0 when dispatch-ahead fully hides device latency.
+        # decode_attn_ms / decode_sample_ms: per-step attention / sampling
+        # cost, populated by profile_decode_phases() (bench or an admin
+        # probe); never computed on a /metrics scrape.
+        self.decode_host_gap_ms = 0.0
+        self.decode_attn_ms = 0.0
+        self.decode_sample_ms = 0.0
 
     # ------------------------------------------------------------------
     # public API
@@ -544,6 +581,12 @@ class InferenceEngine:
             if self._dispatch_decode():
                 dispatched += 1
                 self._chunks_since_decode = 0
+        # Opportunistic drain: results the device already finished cost no
+        # host wait, and every reconcile here frees slots/pages one step
+        # earlier — admission and chunk prep in the NEXT step() overlap
+        # with whatever is still running on device.
+        while self._inflight and self._call_ready(self._inflight[0]):
+            self._reconcile_one()
         if dispatched:
             while len(self._inflight) > self.ecfg.max_inflight:
                 self._reconcile_one()
@@ -551,6 +594,15 @@ class InferenceEngine:
             # Nothing dispatchable: drain so retirements/admissions unblock.
             if self._inflight:
                 self._reconcile_one()
+
+    @staticmethod
+    def _call_ready(call: _Inflight) -> bool:
+        """True when reconciling ``call`` would not block on the device."""
+        arrs = call.arr if isinstance(call.arr, tuple) else (call.arr,)
+        try:
+            return all(a.is_ready() for a in arrs)
+        except AttributeError:  # non-jax payloads (tests with stub arrays)
+            return True
 
     def _reconcile_all(self) -> None:
         while self._inflight:
@@ -1011,7 +1063,8 @@ class InferenceEngine:
 
     # -- decode ---------------------------------------------------------
 
-    def _decode_program(self, n_steps: int, sampled: bool):
+    def _decode_program(self, n_steps: int, sampled: bool,
+                        bounded: bool = False):
         """Build (and cache) the fused K-step decode program.
 
         The scan carries (token, ctx, done, pages[, rng]) on device: each
@@ -1020,14 +1073,20 @@ class InferenceEngine:
         masked state (writes -> null block), and the emitted [K, B] token
         matrix uses -1 for steps where a lane was not active.  Returns
         (toks [K, B], final token state [B], pages).
+
+        ``bounded`` (static, sampled programs only): sample from the top
+        ``sample_topk_cap`` logits per step instead of rank-sorting the
+        full vocab — distribution-exact when every sampling lane has
+        0 < top_k <= cap, which _dispatch_decode verifies per call.
         """
-        key = (n_steps, sampled)
+        key = (n_steps, sampled, bounded)
         prog = self._decode_cache.get(key)
         if prog is not None:
             return prog
 
         cfg = self.cfg
         attn_impl = self._attn_impl
+        k_cap = self.ecfg.sample_topk_cap
 
         def _step_core(params, tokens, ctx, act, pages, tables):
             ctx_eff = jnp.where(act, ctx, 0)
@@ -1048,8 +1107,13 @@ class InferenceEngine:
                     logits, pages = _step_core(
                         params, tokens, ctx, act, pages, tables)
                     rng, sub = jax.random.split(rng)
-                    nxt = sample_tokens(sub, logits, temperature=temp,
-                                        top_k=topk, top_p=topp)
+                    if bounded:
+                        nxt = sample_tokens_bounded(
+                            sub, logits, temperature=temp, top_k=topk,
+                            top_p=topp, k_cap=k_cap)
+                    else:
+                        nxt = sample_tokens(sub, logits, temperature=temp,
+                                            top_k=topk, top_p=topp)
                     nxt = jnp.where(act, nxt, tokens)
                     done = done | (act & (nxt == eos))
                     ctx = jnp.where(act, ctx + 1, ctx)
@@ -1088,6 +1152,83 @@ class InferenceEngine:
             prog = jax.jit(fn, donate_argnums=(1, 4))
         self._decode_cache[key] = prog
         return prog
+
+    def profile_decode_phases(self, reps: int = 3) -> dict[str, float]:
+        """Attribute the fused decode step: attention vs sampling cost.
+
+        Runs the warm compiled decode programs on synthetic full-batch
+        state (all ``max_slots`` lanes live) and differences timings:
+
+          * long-context minus short-context greedy -> ``decode_attn_ms``
+            (only paged attention scales with context length; the dense
+            matmuls and dispatch overhead are ctx-independent), and
+          * sampled minus greedy at short context -> ``decode_sample_ms``.
+
+        The programs append garbage rows into ``self.pages`` as a side
+        effect, so this must only run while the engine is IDLE — bench
+        calls it before serving traffic; it is never triggered by a
+        /metrics scrape.  Populates ``self.decode_attn_ms`` /
+        ``self.decode_sample_ms`` (exported as gauges) and returns all
+        four figures.
+        """
+        if self._inflight or any(s is not None for s in self._slots):
+            raise RuntimeError(
+                "profile_decode_phases() requires an idle engine "
+                "(it clobbers KV pages)")
+        ec = self.ecfg
+        K = ec.decode_steps_per_iter
+        B = ec.max_slots
+        width = ec.max_blocks_per_seq
+        # One shared table row (blocks 1..width): lanes alias the same
+        # pages, which is fine for timing — traffic per lane is identical
+        # to distinct pages and HBM reads don't conflict.
+        nblk = min(width, ec.num_blocks - 1)
+        row = np.zeros((1, width), np.int32)
+        row[0, :nblk] = np.arange(1, 1 + nblk, dtype=np.int32)
+        dtbl = jnp.asarray(np.tile(row, (B, 1)))
+        ctx_hi = max(nblk * ec.block_size - K - 1, 1)
+        ctx_lo = 1
+
+        cap = ec.sample_topk_cap
+        remaining = jnp.full((B,), 10 ** 6, jnp.int32)
+        eos = jnp.asarray(-1, jnp.int32)
+
+        def run(prog, ctx_val: int, sampled: bool) -> float:
+            ctx = jnp.full((B,), ctx_val, jnp.int32)
+            tok = jnp.zeros((B,), jnp.int32)
+            if sampled:
+                extras = (jnp.full((B,), 0.7, jnp.float32),
+                          jnp.full((B,), max(min(cap, 8), 1), jnp.int32),
+                          jnp.full((B,), 0.9, jnp.float32),
+                          jax.random.PRNGKey(0), eos)
+            else:
+                extras = (eos,)
+            # Warm (compile) call, then timed reps.  tok_state and pages
+            # are donated — thread both through every call.
+            _, tok, self.pages = prog(self.params, tok, ctx, remaining,
+                                      self.pages, dtbl, *extras)
+            tok.block_until_ready()
+            t0 = time.monotonic()
+            for _ in range(reps):
+                _, tok, self.pages = prog(self.params, tok, ctx, remaining,
+                                          self.pages, dtbl, *extras)
+            tok.block_until_ready()
+            return (time.monotonic() - t0) / (reps * K) * 1e3
+
+        greedy_prog = self._decode_program(K, sampled=False)
+        sampled_prog = self._decode_program(K, sampled=True,
+                                            bounded=cap > 0)
+        t_lo = run(greedy_prog, ctx_lo, sampled=False)
+        t_hi = run(greedy_prog, ctx_hi, sampled=False)
+        t_samp = run(sampled_prog, ctx_lo, sampled=True)
+        self.decode_attn_ms = max(t_hi - t_lo, 0.0)
+        self.decode_sample_ms = max(t_samp - t_lo, 0.0)
+        return {
+            "decode_step_ms_short_ctx": t_lo,
+            "decode_step_ms_long_ctx": t_hi,
+            "decode_attn_ms": self.decode_attn_ms,
+            "decode_sample_ms": self.decode_sample_ms,
+        }
 
     def _spec_program(self, k: int, rounds: int, sampled: bool,
                       filtered: bool = False):
@@ -1346,7 +1487,13 @@ class InferenceEngine:
             kind = "decode"
             self.steps += K
         else:
-            prog = self._decode_program(K, sampled=True)
+            # Bounded top-k sampling is exact only when every lane that
+            # actually samples keeps at most sample_topk_cap tokens.
+            cap = ec.sample_topk_cap
+            bounded = cap > 0 and all(
+                0 < s.req.sampling.top_k <= cap
+                for _, s in lanes if s.req.sampling.temperature > 0.0)
+            prog = self._decode_program(K, sampled=True, bounded=bounded)
             self._rng, sub = jax.random.split(self._rng)
             toks, self._tok_state, self.pages = prog(
                 self.params, self._tok_state, jnp.asarray(ctx),
@@ -1370,6 +1517,7 @@ class InferenceEngine:
 
     def _reconcile_one(self) -> None:
         call = self._inflight.popleft()
+        gap_t0 = time.monotonic()
         if call.kind == "spec":
             toks, stats = call.arr
             arr = np.asarray(toks)
@@ -1384,6 +1532,14 @@ class InferenceEngine:
                                   else 0.8 * self._spec_ema + 0.2 * rate)
         else:
             arr = np.asarray(call.arr)
+        if call.kind in ("decode", "spec"):
+            # Host time spent blocked on this device call: ~0 whenever
+            # dispatch-ahead (or the ready-drain in step()) hid the device
+            # latency.  EMA so /metrics shows the steady-state gap.
+            gap_ms = (time.monotonic() - gap_t0) * 1e3
+            self.decode_host_gap_ms = (
+                gap_ms if self.decode_host_gap_ms == 0.0
+                else 0.9 * self.decode_host_gap_ms + 0.1 * gap_ms)
         if call.kind in ("admit", "chunk"):
             now = time.monotonic()
             for s in call.touched:           # chunk calls: drain refcounts
