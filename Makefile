@@ -5,7 +5,7 @@ PY ?= python
 TEST_ENV = env PYTHONPATH= JAX_PLATFORMS=cpu
 SHELL := /bin/bash    # tier1 uses pipefail/PIPESTATUS
 
-.PHONY: run run-agent run-scheduler demo test test-fast tier1 bench \
+.PHONY: run run-agent run-scheduler demo test test-fast tier1 chaos bench \
         bench-decode dryrun smoke preflight deploy-agent docker \
         docker-agent docker-scheduler lint clean
 
@@ -40,6 +40,9 @@ tier1:              # the driver's verify gate, verbatim (ROADMAP.md)
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log \
 	  | tr -cd . | wc -c); \
 	exit $$rc
+
+chaos:              # fault-injection resilience suite (docs/resilience.md)
+	$(TEST_ENV) $(PY) -m pytest tests/ -q -m chaos -p no:cacheprovider
 
 bench:
 	$(PY) bench.py

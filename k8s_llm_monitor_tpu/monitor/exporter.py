@@ -128,6 +128,52 @@ def _engine_metrics(w: _Writer, engine) -> None:
     w.lines.append(f"{_PREFIX}_engine_ttft_seconds_count {engine.ttft_count}")
 
 
+_HEALTH_STATES = ("healthy", "degraded", "draining", "unhealthy")
+
+
+def _resilience_metrics(w: _Writer, engine, service) -> None:
+    """Health state machine + failure-recovery counters (PR 2)."""
+    if service is not None:
+        state = service.health.state()
+        w.metric("health_state", "gauge",
+                 "Live health state (1 = current state)",
+                 [(f'{{state="{s}"}}', 1 if s == state else 0)
+                  for s in _HEALTH_STATES])
+        w.metric("sheds_total", "counter",
+                 "Submissions refused by load shedding",
+                 [("", service.health.sheds)])
+    w.metric("engine_watchdog_trips_total", "counter",
+             "Dispatch watchdog expirations (pipeline resets)",
+             [("", engine.watchdog_trips)])
+    w.metric("engine_dispatch_failures_total", "counter",
+             "Dispatch or reconcile failures recovered by the engine",
+             [("", engine.dispatch_failures)])
+    w.metric("engine_deadline_expired_total", "counter",
+             "Requests failed by deadline/queue-TTL enforcement",
+             [("", engine.deadline_expired)])
+    w.metric("engine_requeues_total", "counter",
+             "Slots recompute-requeued after a pipeline reset",
+             [("", engine.requeues)])
+    w.metric("engine_slot_wait_seconds", "gauge",
+             "EMA of queue wait before a request wins a slot "
+             "(load-shedding signal)",
+             [("", round(engine.slot_wait_ema_s, 6))])
+
+
+def _kube_breaker_metrics(w: _Writer, breaker) -> None:
+    states = ("closed", "open", "half-open")
+    state = breaker.state
+    w.metric("kube_breaker_state", "gauge",
+             "Kube apiserver circuit breaker state (1 = current state)",
+             [(f'{{state="{s}"}}', 1 if s == state else 0) for s in states])
+    w.metric("kube_breaker_trips_total", "counter",
+             "Times the apiserver circuit breaker opened",
+             [("", breaker.trips)])
+    w.metric("kube_breaker_rejections_total", "counter",
+             "Apiserver calls refused while the breaker was open",
+             [("", breaker.rejections)])
+
+
 def _manager_metrics(w: _Writer, manager) -> None:
     w.metric("collections_total", "counter",
              "Metrics collection cycles completed",
@@ -181,11 +227,17 @@ def render_prometheus(srv: "MonitorServer") -> str:
     w.metric("build_info", "gauge", "Monitor build info",
              [('{version="1.0.0"}', 1)])
     engine = None
+    service = None
     if srv.analysis is not None:
         backend = getattr(srv.analysis, "backend", None)
         engine = getattr(backend, "engine", None)
+        service = getattr(backend, "service", None)
     if engine is not None:
         _engine_metrics(w, engine)
+        _resilience_metrics(w, engine, service)
+    breaker = getattr(getattr(srv.client, "backend", None), "breaker", None)
+    if breaker is not None:
+        _kube_breaker_metrics(w, breaker)
     if srv.manager is not None:
         _manager_metrics(w, srv.manager)
     _device_metrics(w)

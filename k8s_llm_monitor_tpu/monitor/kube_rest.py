@@ -52,6 +52,12 @@ from k8s_llm_monitor_tpu.monitor.cluster import (
     NotFound,
     WatchStream,
 )
+from k8s_llm_monitor_tpu.resilience.faults import get_injector
+from k8s_llm_monitor_tpu.resilience.retry import (
+    Backoff,
+    CircuitBreaker,
+    CircuitOpen,
+)
 
 logger = logging.getLogger("monitor.kube_rest")
 
@@ -162,11 +168,25 @@ class KubeRestBackend(ClusterBackend):
         ssl_context: ssl.SSLContext | None = None,
         timeout: float = 15.0,
         watch_timeout: float = 3600.0,
+        backoff: Backoff | None = None,
+        breaker: CircuitBreaker | None = None,
     ) -> None:
         self.base_url = base_url.rstrip("/")
         self.token = token
-        self.timeout = timeout
-        self.watch_timeout = watch_timeout
+        # Every HTTP call carries an explicit socket timeout: ``timeout``
+        # for unary requests, ``watch_timeout`` for streams (a watch is
+        # *supposed* to idle; a GET is not).  Neither may be None — an
+        # unbounded read on a dead apiserver wedges every poll thread.
+        self.timeout = float(timeout)
+        self.watch_timeout = float(watch_timeout)
+        # Retry discipline shared by every unary request; the breaker also
+        # gates watch connects so a 5xx storm cannot be amplified by the
+        # poll + watcher threads hammering a struggling apiserver.
+        self.backoff = backoff or Backoff(
+            base_s=0.2, cap_s=5.0, attempts=4)
+        self.breaker = breaker or CircuitBreaker(
+            failure_threshold=5, cooldown_s=10.0)
+        self._sleep = time.sleep  # injectable (tests avoid real sleeps)
         self._ctx = ssl_context
         handlers = []
         if ssl_context is not None:
@@ -175,9 +195,19 @@ class KubeRestBackend(ClusterBackend):
         # Temp cert/key files (from inline kubeconfig data); unlinked by
         # close() — registered atexit by from_kubeconfig.
         self._tmpfiles: list[str] = []
+        # Live watch streams; close() severs them so blocked reader
+        # threads exit instead of outliving the backend.
+        self._streams_lock = threading.Lock()
+        self._streams: list[_HttpWatchStream] = []
 
     def close(self) -> None:
-        """Remove materialized credential files (idempotent)."""
+        """Tear down in-flight watch streams and remove materialized
+        credential files (idempotent)."""
+        with self._streams_lock:
+            streams = list(self._streams)
+            self._streams.clear()
+        for s in streams:
+            s.close()
         while self._tmpfiles:
             path = self._tmpfiles.pop()
             try:
@@ -301,6 +331,62 @@ class KubeRestBackend(ClusterBackend):
         raw: bool = False,
         stream: bool = False,
     ) -> Any:
+        """One apiserver call with retry + circuit breaking.
+
+        Retriable failures (5xx, timeout, connection errors) retry through
+        the jittered ``backoff`` budget — except POSTs (not idempotent: a
+        timed-out create may have landed) and streams (the watcher's
+        reconnect loop owns that retry).  404/409 are caller-level
+        outcomes, not apiserver failures: they close the breaker and never
+        retry.  When the breaker is open the call fails fast with
+        ``ClusterError`` instead of queueing behind a dead apiserver.
+        """
+        retriable = not stream and method != "POST"
+        attempts = self.backoff.attempts if retriable else 1
+        last: Exception | None = None
+        for attempt in range(attempts):
+            try:
+                self.breaker.before_call()
+            except CircuitOpen as exc:
+                raise ClusterError(f"{method} {path}: {exc}") from exc
+            try:
+                result = self._request_once(
+                    path, params, method=method, body=body,
+                    raw=raw, stream=stream)
+            except (NotFound, Conflict):
+                self.breaker.record_success()
+                raise
+            except ClusterError as exc:
+                self.breaker.record_failure()
+                last = exc
+                if attempt + 1 < attempts:
+                    self._sleep(self.backoff.delay(attempt))
+                continue
+            self.breaker.record_success()
+            return result
+        assert last is not None
+        raise last
+
+    def _request_once(
+        self,
+        path: str,
+        params: dict[str, Any] | None = None,
+        *,
+        method: str = "GET",
+        body: dict | None = None,
+        raw: bool = False,
+        stream: bool = False,
+    ) -> Any:
+        faults = get_injector()
+        if faults.should_fire("kube_http_timeout"):
+            raise ClusterError(
+                f"{method} {path} failed: injected: timed out")
+        if faults.should_fire("kube_http_reset"):
+            raise ClusterError(
+                f"{method} {path} failed: injected: connection reset by peer")
+        if faults.should_fire("kube_http_5xx"):
+            raise ClusterError(
+                f"{method} {path} -> 503: injected: apiserver unavailable")
         url = self.base_url + path
         if params:
             url += "?" + urllib.parse.urlencode(params, doseq=True)
@@ -344,6 +430,8 @@ class KubeRestBackend(ClusterBackend):
         params["watch"] = "1"
         resp = self._request(path, params, stream=True)
         stream = _HttpWatchStream(resp)
+        with self._streams_lock:
+            self._streams.append(stream)
 
         def reader() -> None:
             try:
@@ -363,6 +451,9 @@ class KubeRestBackend(ClusterBackend):
                 logger.debug("watch %s ended: %s", path, exc)
             finally:
                 stream.close()
+                with self._streams_lock:
+                    if stream in self._streams:
+                        self._streams.remove(stream)
 
         threading.Thread(target=reader, daemon=True,
                          name=f"kube-watch{path}").start()

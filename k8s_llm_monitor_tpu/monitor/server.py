@@ -77,6 +77,53 @@ class MonitorServer:
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
+    # -- health ----------------------------------------------------------------
+
+    def engine_service(self):
+        """The wired EngineService, when a local engine backend is up."""
+        backend = getattr(self.analysis, "backend", None)
+        return getattr(backend, "service", None)
+
+    def health_snapshot(self) -> dict[str, Any]:
+        """Aggregate live health across the wired components — the body of
+        ``/health``.  Dev mode (no engine) is healthy by definition: there
+        is nothing to degrade."""
+        snap: dict[str, Any] = {
+            "status": "healthy",
+            "reason": "",
+            "ready": True,
+            "timestamp": _now(),
+            "version": VERSION,
+        }
+        svc = self.engine_service()
+        if svc is not None:
+            h = svc.health.snapshot()
+            engine = svc.engine
+            snap["status"] = h["state"]
+            snap["reason"] = h["reason"]
+            snap["ready"] = h["ready"]
+            snap["engine"] = {
+                "queue_depth": engine.queue_depth,
+                "active_slots": engine.active_slots,
+                "sheds": h["totals"]["sheds"],
+                "recent_shed_rate": h["recent"]["shed_rate"],
+                "watchdog_trips": engine.watchdog_trips,
+                "dispatch_failures": engine.dispatch_failures,
+                "consecutive_dispatch_failures":
+                    engine.consecutive_dispatch_failures,
+                "deadline_expired": engine.deadline_expired,
+                "requeues": engine.requeues,
+            }
+        breaker = getattr(getattr(self.client, "backend", None),
+                          "breaker", None)
+        if breaker is not None:
+            snap["kube_breaker"] = {
+                "state": breaker.state,
+                "trips": breaker.trips,
+                "rejections": breaker.rejections,
+            }
+        return snap
+
     # -- lifecycle -------------------------------------------------------------
 
     def start(self) -> None:
@@ -114,6 +161,7 @@ class MonitorServer:
 # getattr because handler instances are created per connection)
 _ROUTES: dict[tuple[str, str], str] = {
     ("GET", "/health"): "h_health",
+    ("GET", "/readyz"): "h_readyz",
     ("GET", "/metrics"): "h_prometheus",
     ("POST", "/debug/profile"): "h_profile",
     ("GET", "/api/v1/cluster/status"): "h_cluster_status",
@@ -236,8 +284,22 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
         # -- handlers ----------------------------------------------------------
 
         def h_health(self) -> None:
+            # Real state, not a literal: DEGRADED still serves (200),
+            # DRAINING/UNHEALTHY answer 503 so probes stop routing here.
+            snap = srv.health_snapshot()
+            self._send_json(snap, status=200 if snap["ready"] else 503)
+
+        def h_readyz(self) -> None:
+            """Readiness probe: should this replica receive traffic?"""
+            snap = srv.health_snapshot()
             self._send_json(
-                {"status": "healthy", "timestamp": _now(), "version": VERSION}
+                {
+                    "ready": snap["ready"],
+                    "status": snap["status"],
+                    "reason": snap["reason"],
+                    "timestamp": snap["timestamp"],
+                },
+                status=200 if snap["ready"] else 503,
             )
 
         def h_prometheus(self) -> None:

@@ -38,6 +38,16 @@ from k8s_llm_monitor_tpu.monitor.models import (
     parse_rfc3339,
     utcnow,
 )
+from k8s_llm_monitor_tpu.resilience.retry import Backoff
+
+
+def _reconnect_backoff(cap_s: float) -> Backoff:
+    """The shared reconnect curve: start fast (a blip reconnects in sub-
+    second), grow to the configured cap (the old fixed delay) so a down
+    apiserver is not hammered.  ``attempts`` is irrelevant here — watch
+    loops reconnect forever and only stop() ends them."""
+    return Backoff(base_s=min(0.25, cap_s), cap_s=cap_s, mult=2.0,
+                   jitter=0.2, attempts=2)
 
 logger = logging.getLogger("monitor.watcher")
 
@@ -73,6 +83,7 @@ class Watcher:
         self.handler = handler
         self.namespaces = list(namespaces or client.namespaces())
         self.reconnect_delay = reconnect_delay
+        self.backoff = _reconnect_backoff(reconnect_delay)
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._streams: list[WatchStream] = []
@@ -119,18 +130,22 @@ class Watcher:
             stream.close()
 
     def _watch_loop(self, kind: str, namespace: str) -> None:
+        fail_streak = 0
         while not self._stop.is_set():
             try:
                 stream = self.client.watch(kind, namespace)
             except ClusterError as exc:
                 logger.warning("watch %s/%s failed: %s; retrying", kind, namespace, exc)
-                self._stop.wait(self.reconnect_delay)
+                self._stop.wait(self.backoff.delay(fail_streak))
+                fail_streak += 1
                 continue
             self._register(stream)
+            delivered = False
             try:
                 for event_type, obj in stream:
                     if self._stop.is_set():
                         return
+                    delivered = True
                     self._dispatch(kind, event_type, obj)
             except Exception:
                 logger.exception("watch %s/%s dispatch error", kind, namespace)
@@ -138,8 +153,15 @@ class Watcher:
                 with self._lock:
                     if stream in self._streams:
                         self._streams.remove(stream)
-            # stream closed server-side → reconnect (ref watcher.go:84-87)
-            self._stop.wait(self.reconnect_delay)
+            # stream closed server-side → reconnect (ref watcher.go:84-87).
+            # A stream that delivered events was a real session: reconnect
+            # from the bottom of the curve.  One that closed without ever
+            # delivering counts as another failure.
+            if delivered:
+                fail_streak = 0
+            self._stop.wait(self.backoff.delay(fail_streak))
+            if not delivered:
+                fail_streak += 1
 
     def _dispatch(self, kind: str, event_type: str, obj: dict[str, Any]) -> None:
         if kind == "pods":
@@ -200,6 +222,7 @@ class CRDWatcher:
         self.client = client
         self.handler = handler
         self.reconnect_delay = reconnect_delay
+        self.backoff = _reconnect_backoff(reconnect_delay)
         self._stop = threading.Event()
         self._lock = threading.Lock()
         self._threads: list[threading.Thread] = []
@@ -273,13 +296,16 @@ class CRDWatcher:
     # -- watch loops ----------------------------------------------------------
 
     def _crd_watch_loop(self) -> None:
+        fail_streak = 0
         while not self._stop.is_set():
             try:
                 stream = self.client.backend.watch_crds()
             except ClusterError as exc:
                 logger.warning("CRD watch failed: %s; retrying", exc)
-                self._stop.wait(self.reconnect_delay)
+                self._stop.wait(self.backoff.delay(fail_streak))
+                fail_streak += 1
                 continue
+            fail_streak = 0
             self._register(stream)
             try:
                 for event_type, raw in stream:
@@ -300,7 +326,7 @@ class CRDWatcher:
                 with self._lock:
                     if stream in self._streams:
                         self._streams.remove(stream)
-            self._stop.wait(self.reconnect_delay)
+            self._stop.wait(self.backoff.delay(0))
 
     def _cr_watch_loop(self, raw_crd: dict[str, Any]) -> None:
         spec = raw_crd.get("spec", {})
@@ -310,6 +336,7 @@ class CRDWatcher:
         plural = names.get("plural", "")
         version = storage_version(raw_crd)
         namespaced = spec.get("scope", "Namespaced") == "Namespaced"
+        fail_streak = 0
         while not self._stop.is_set():
             try:
                 stream = self.client.backend.watch_custom_resources(
@@ -317,8 +344,10 @@ class CRDWatcher:
                 )
             except ClusterError as exc:
                 logger.warning("CR watch %s.%s failed: %s", plural, group, exc)
-                self._stop.wait(self.reconnect_delay)
+                self._stop.wait(self.backoff.delay(fail_streak))
+                fail_streak += 1
                 continue
+            fail_streak = 0
             self._register(stream)
             try:
                 for event_type, obj in stream:
@@ -329,7 +358,7 @@ class CRDWatcher:
                 with self._lock:
                     if stream in self._streams:
                         self._streams.remove(stream)
-            self._stop.wait(self.reconnect_delay)
+            self._stop.wait(self.backoff.delay(0))
 
     def _handle_cr_event(
         self, event_type: str, obj: dict[str, Any], group: str, kind: str, version: str

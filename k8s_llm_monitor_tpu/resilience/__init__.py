@@ -1,0 +1,52 @@
+"""Resilience layer: fault injection, retry/backoff, circuit breaking, and
+the live health state machine.
+
+The serving stack (serving/engine.py, serving/service.py) and the monitor
+plane (monitor/kube_rest.py, monitor/watcher.py, monitor/server.py) share
+this package so that every failure mode has ONE definition, one injection
+point, and one observable surface:
+
+  * ``faults``  — process-global :class:`FaultInjector` with named failure
+    points, configured by ``K8SLLM_FAULTS`` or programmatically (tests);
+  * ``retry``   — jittered exponential :class:`Backoff` with a retry budget
+    and the :class:`CircuitBreaker` used by the kube REST backend;
+  * ``health``  — :class:`HealthMonitor`, the HEALTHY → DEGRADED →
+    DRAINING/UNHEALTHY state machine behind ``/health`` and ``/readyz``.
+
+Everything here is stdlib-only and CPU-deterministic (seeded RNGs,
+injectable clocks) so chaos tests reproduce bit-identically in CI.
+"""
+
+from k8s_llm_monitor_tpu.resilience.faults import (
+    FAULT_POINTS,
+    FaultError,
+    FaultInjector,
+    get_injector,
+)
+from k8s_llm_monitor_tpu.resilience.health import (
+    DEGRADED,
+    DRAINING,
+    HEALTHY,
+    UNHEALTHY,
+    HealthMonitor,
+)
+from k8s_llm_monitor_tpu.resilience.retry import (
+    Backoff,
+    CircuitBreaker,
+    CircuitOpen,
+)
+
+__all__ = [
+    "FAULT_POINTS",
+    "FaultError",
+    "FaultInjector",
+    "get_injector",
+    "Backoff",
+    "CircuitBreaker",
+    "CircuitOpen",
+    "HealthMonitor",
+    "HEALTHY",
+    "DEGRADED",
+    "DRAINING",
+    "UNHEALTHY",
+]

@@ -61,6 +61,7 @@ import numpy as np
 
 from k8s_llm_monitor_tpu.models import llama
 from k8s_llm_monitor_tpu.models.config import ModelConfig
+from k8s_llm_monitor_tpu.resilience.faults import FaultError, get_injector
 from k8s_llm_monitor_tpu.ops.sampling import (
     greedy_tokens,
     sample_tokens,
@@ -102,6 +103,14 @@ class GenerationRequest:
     # (engine._admit_round); caps the dense-lane rule at one round and
     # keeps the deferral counter per-request.
     prefix_deferred: bool = False
+    # Wall-clock budget from submit (seconds); 0 = none.  Enforced at
+    # admission and per step(): an expired request fails with a
+    # "deadline exceeded" cause instead of occupying KV pages forever.
+    deadline_s: float = 0.0
+    # Times this request was recompute-requeued by a pipeline reset
+    # (watchdog trip / dispatch failure); bounded by
+    # EngineConfig.max_requeues, then the request fails with the cause.
+    requeues: int = 0
 
 
 @dataclasses.dataclass
@@ -182,13 +191,36 @@ class EngineConfig:
     # History window for n-gram matching, per lane (tokens; rounded down to
     # the per-seq capacity).  [max_slots, cap] int32 is KBs, not MBs.
     spec_hist_cap: int = 4096
+    # --- resilience (docs/resilience.md) ------------------------------
+    # Default time-to-live for requests still waiting in the pending
+    # queue (seconds; 0 = none).  A request with its own deadline_s uses
+    # that instead.  Queued work past its TTL fails at the next step()
+    # instead of occupying the queue (and later KV pages) for a caller
+    # that has long since timed out.
+    queue_ttl_s: float = 0.0
+    # Inflight watchdog: wall-clock budget for the oldest dispatched call
+    # to become ready at reconcile time (seconds; 0 = disabled, block
+    # forever as before).  On expiry the engine performs a pipeline
+    # reset: in-flight results are dropped, affected slots are
+    # recompute-requeued (bounded by max_requeues) and the engine keeps
+    # serving instead of wedging on a stuck device dispatch.
+    dispatch_timeout_s: float = 0.0
+    # Recompute-requeue budget per request across pipeline resets;
+    # exceeded -> the request fails with the reset cause.
+    max_requeues: int = 2
+    # Load shedding thresholds (0 = disabled).  should_shed() reports a
+    # reason when the pending-queue token backlog or the admission-wait
+    # EMA crosses its threshold; EngineService turns that into a
+    # retriable OverloadedError at submit time.
+    shed_queue_tokens: int = 0
+    shed_slot_wait_s: float = 0.0
 
 
 class _Slot:
     __slots__ = ("req", "blocks", "ctx_len", "generated", "pending_admit",
                  "inflight_decode", "first_token_time", "retired",
                  "cancel_requested", "prefill_pos", "prefilling",
-                 "inflight_chunks")
+                 "inflight_chunks", "abort_cause")
 
     def __init__(self, req: GenerationRequest, blocks: list[int]):
         self.req = req
@@ -200,6 +232,9 @@ class _Slot:
         self.first_token_time = 0.0
         self.retired = False
         self.cancel_requested = False
+        # When set, retirement produces an error result with this cause
+        # (deadline expiry, pipeline-reset give-up) instead of eos/length.
+        self.abort_cause = ""
         # Long-prompt streaming admission: tokens dispatched so far and
         # whether more chunks remain (decode skips prefilling slots).
         self.prefill_pos = 0
@@ -232,6 +267,26 @@ class _Inflight:
     lanes: list[tuple]
     # chunk: every slot touched by the call (inflight_chunks decrement).
     touched: list = dataclasses.field(default_factory=list)
+
+
+class _StuckPayload:
+    """Wraps a dispatched device payload so it never reports ready — the
+    deterministic CPU stand-in for a wedged device call (fault point
+    ``decode_stuck``).  Conversion raises too, so a run with the watchdog
+    disabled fails loudly through the reconcile-reset path instead of
+    silently reading the real array."""
+
+    def __init__(self, inner: Any):
+        self.inner = inner
+
+    def is_ready(self) -> bool:
+        return False
+
+    def __array__(self, *args, **kwargs):
+        raise FaultError("decode_stuck")
+
+    def __iter__(self):
+        raise FaultError("decode_stuck")
 
 
 # Sink signature: (request_id, new_token_ids, result_or_none).  ``result`` is
@@ -446,6 +501,20 @@ class InferenceEngine:
         self.prefills = 0
         self.preemptions = 0
         self._chunks_since_decode = 0
+        # Resilience state (docs/resilience.md).  ``health`` is an optional
+        # HealthMonitor attached by EngineService; the engine records
+        # watchdog trips and dispatch outcomes into it directly so the
+        # state machine sees events the moment they happen.
+        self._faults = get_injector()
+        self.health = None
+        self.dispatch_failures = 0
+        self.consecutive_dispatch_failures = 0
+        self.watchdog_trips = 0
+        self.deadline_expired = 0
+        self.requeues = 0
+        # EMA of submit->admission wait; a shed signal when slots churn
+        # slower than the arrival rate.
+        self.slot_wait_ema_s = 0.0
         # TTFT histogram (Prometheus semantics: cumulative le buckets +
         # sum/count), observed once per request at admission reconcile.
         self.ttft_buckets: tuple[float, ...] = (
@@ -538,8 +607,29 @@ class InferenceEngine:
         return len(self._pending)
 
     @property
+    def queue_tokens(self) -> int:
+        """Prompt-token backlog waiting for admission (shed signal)."""
+        return sum(len(r.prompt_ids) for r in self._pending)
+
+    @property
     def active_slots(self) -> int:
         return sum(1 for s in self._slots if s is not None)
+
+    def should_shed(self) -> str:
+        """Non-empty reason when new work should be shed (admission
+        control): queue-token backlog or admission-wait EMA above the
+        configured thresholds.  The caller (EngineService.submit) turns
+        this into a retriable ``OverloadedError``; the engine itself never
+        rejects — by the time work reaches ``submit()`` the caller has
+        already been told to back off."""
+        ec = self.ecfg
+        if 0 < ec.shed_queue_tokens <= self.queue_tokens:
+            return (f"queue token backlog {self.queue_tokens} >= "
+                    f"{ec.shed_queue_tokens}")
+        if 0 < ec.shed_slot_wait_s <= self.slot_wait_ema_s:
+            return (f"admission wait EMA {self.slot_wait_ema_s:.2f}s >= "
+                    f"{ec.shed_slot_wait_s:.2f}s")
+        return ""
 
     def generate(self, prompts: list[list[int]],
                  sampling: SamplingParams | None = None) -> list[GenerationResult]:
@@ -567,6 +657,7 @@ class InferenceEngine:
         batched prefills and one fused decode, then reconcile in-flight
         results down to the dispatch-ahead window (or fully, when there is
         nothing left to dispatch)."""
+        self._enforce_deadlines()
         dispatched = 0
         rounds = 0
         while rounds < self.ecfg.max_admission_rounds and self._admit_round():
@@ -607,6 +698,138 @@ class InferenceEngine:
     def _reconcile_all(self) -> None:
         while self._inflight:
             self._reconcile_one()
+
+    # -- deadlines / failure recovery -----------------------------------
+
+    def _deadline_of(self, req: GenerationRequest, queued: bool) -> float:
+        """Absolute monotonic deadline for ``req``; +inf when unbounded.
+        A per-request deadline_s always applies; the config queue TTL only
+        bounds time spent *waiting* (a running request already holds its
+        pages — killing it at TTL would waste the work done)."""
+        if req.deadline_s > 0:
+            return req.submit_time + req.deadline_s
+        if queued and self.ecfg.queue_ttl_s > 0:
+            return req.submit_time + self.ecfg.queue_ttl_s
+        return float("inf")
+
+    def _enforce_deadlines(self) -> None:
+        """Fail expired queued requests and abort expired running slots.
+        Runs at the top of every step(); admission re-checks queued
+        candidates so a request never spends KV pages after expiry."""
+        now = time.monotonic()
+        if self._pending:
+            keep: collections.deque[GenerationRequest] = collections.deque()
+            for req in self._pending:
+                if now > self._deadline_of(req, queued=True):
+                    self.deadline_expired += 1
+                    self._fail_request(
+                        req, f"deadline exceeded after "
+                             f"{now - req.submit_time:.2f}s in queue")
+                else:
+                    keep.append(req)
+            self._pending = keep
+        for s in self._slots:
+            if (s is not None and not s.retired and not s.cancel_requested
+                    and now > self._deadline_of(s.req, queued=False)):
+                self.deadline_expired += 1
+                s.abort_cause = (f"deadline exceeded after "
+                                 f"{now - s.req.submit_time:.2f}s "
+                                 f"({len(s.generated)} tokens generated)")
+                # Reuse the cancel path: no new dispatches; the slot
+                # retires once its in-flight steps settle.
+                s.cancel_requested = True
+
+    def _record_dispatch_failure(self, exc: BaseException) -> None:
+        self.dispatch_failures += 1
+        self.consecutive_dispatch_failures += 1
+        if self.health is not None:
+            self.health.record_dispatch_failure()
+
+    def _record_dispatch_ok(self) -> None:
+        self.consecutive_dispatch_failures = 0
+        if self.health is not None:
+            self.health.record_dispatch_ok()
+
+    def _note_admission_wait(self, req: GenerationRequest) -> None:
+        """Track how long requests sit queued before winning a slot — the
+        EMA backs the ``shed_slot_wait_s`` load-shedding signal."""
+        wait = time.monotonic() - req.submit_time
+        if self.slot_wait_ema_s == 0.0:
+            self.slot_wait_ema_s = wait
+        else:
+            self.slot_wait_ema_s = (
+                0.9 * self.slot_wait_ema_s + 0.1 * wait)
+
+    def _requeue_or_fail(self, slot_idx: int, cause: str) -> None:
+        """Recovery path for a slot whose in-flight work was lost (pipeline
+        reset): recompute-requeue with generated tokens folded into the
+        prompt, bounded by ``max_requeues``, then fail with the cause.
+        Caller must have zeroed the slot's inflight counters and released
+        any deferred frees first."""
+        s = self._slots[slot_idx]
+        assert s is not None
+        self.allocator.free(s.blocks)
+        self._slots[slot_idx] = None
+        s.retired = True
+        req = s.req
+        if s.cancel_requested or req.requeues >= self.ecfg.max_requeues:
+            # No caller left to retry for (cancelled / deadline-aborted)
+            # or the requeue budget is spent: finish now with the cause.
+            # Fold reconciled tokens into the prompt first so the error
+            # result still carries the partial output.
+            if s.generated:
+                req.prompt_ids = req.prompt_ids + s.generated
+            if s.cancel_requested:
+                self._fail_request(req, s.abort_cause or "cancelled")
+            else:
+                self._fail_request(
+                    req, f"{cause} (gave up after {req.requeues} requeues)")
+            return
+        req.requeues += 1
+        self.requeues += 1
+        consumed = len(s.generated)
+        if consumed:
+            req.prompt_ids = req.prompt_ids + s.generated
+            req.sampling = dataclasses.replace(
+                req.sampling,
+                max_tokens=max(1, req.sampling.max_tokens - consumed))
+        self._cap_request(req)
+        self._pending.appendleft(req)
+
+    def _reset_pipeline(self, cause: str,
+                        extra_calls: tuple = ()) -> None:
+        """Drop every in-flight call and recover the engine to a clean,
+        serving state after a stuck or failed dispatch.
+
+        Device-side page/token-buffer contents are suspect after a lost
+        call (later dispatches in the chain consumed the failed call's
+        donated buffers), so every live slot recovers by recompute: its
+        reconciled tokens fold into the prompt and it re-queues (bounded
+        by ``max_requeues``).  Shared prefix pages are dropped for the
+        same reason.  The allocator's free count returns to its idle
+        baseline — nothing leaks across a reset."""
+        calls = list(extra_calls) + list(self._inflight)
+        self._inflight.clear()
+        for call in calls:
+            if call.kind in ("decode", "spec"):
+                for _, s, _steps in call.lanes:
+                    s.inflight_decode = 0
+            elif call.kind == "chunk":
+                for s in call.touched:
+                    s.inflight_chunks = 0
+        # No in-flight call references retired pages anymore.
+        for _, blocks in self._deferred_frees:
+            self.allocator.free(blocks)
+        self._deferred_frees.clear()
+        # Cached prefix pages may hold partial writes from the lost calls.
+        if self.prefix_cache is not None:
+            self.prefix_cache.clear()
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            s.inflight_decode = 0
+            s.inflight_chunks = 0
+            self._requeue_or_fail(i, cause)
 
     # -- admission ------------------------------------------------------
 
@@ -806,6 +1029,13 @@ class InferenceEngine:
                 # prefix recompute.
                 break
             req = self._pending[0]
+            if time.monotonic() > self._deadline_of(req, queued=True):
+                self._pending.popleft()
+                self.deadline_expired += 1
+                self._fail_request(
+                    req, f"deadline exceeded after "
+                         f"{time.monotonic() - req.submit_time:.2f}s in queue")
+                continue
             L = len(req.prompt_ids)
             if L + 1 > self.capacity_tokens:
                 # Defensive: submit() caps requests, so this only catches
@@ -865,7 +1095,16 @@ class InferenceEngine:
                     self.prefix_cache.misses += 1
             if req.orig_prompt_len < 0:
                 req.orig_prompt_len = L
-            blocks = shared + self.allocator.alloc(L + 1 - shared_toks)
+            try:
+                blocks = shared + self.allocator.alloc(L + 1 - shared_toks)
+            except OutOfBlocks:
+                # can_alloc said yes but alloc still failed (injected
+                # exhaustion, or a racing sharer): push back, end the scan.
+                if shared:
+                    self.allocator.free(shared)
+                self._pending.appendleft(req)
+                break
+            self._note_admission_wait(req)
             if L - shared_toks > top:
                 # Long suffix: occupy a slot in *prefilling* state — its
                 # chunks stream one batched round per engine step
@@ -921,33 +1160,55 @@ class InferenceEngine:
             temp[j], topk[j], topp[j] = sp.temperature, sp.top_k, sp.top_p
 
         all_greedy = all(r.sampling.temperature <= 0.0 for _, r, _, _ in batch)
-        if not any_shared:
-            if all_greedy:
-                first, self.pages = self._prefill_greedy(
-                    self.params, self._tokens_to_device(tokens), jnp.asarray(lengths),
-                    self.pages, jnp.asarray(tables),
-                )
+        try:
+            self._faults.maybe_raise("prefill_dispatch")
+            if not any_shared:
+                if all_greedy:
+                    first, self.pages = self._prefill_greedy(
+                        self.params, self._tokens_to_device(tokens), jnp.asarray(lengths),
+                        self.pages, jnp.asarray(tables),
+                    )
+                else:
+                    self._rng, sub = jax.random.split(self._rng)
+                    first, self.pages = self._prefill_sample(
+                        self.params, self._tokens_to_device(tokens), jnp.asarray(lengths),
+                        self.pages, jnp.asarray(tables), jnp.asarray(temp),
+                        jnp.asarray(topk), jnp.asarray(topp), sub,
+                    )
             else:
-                self._rng, sub = jax.random.split(self._rng)
-                first, self.pages = self._prefill_sample(
-                    self.params, self._tokens_to_device(tokens), jnp.asarray(lengths),
-                    self.pages, jnp.asarray(tables), jnp.asarray(temp),
-                    jnp.asarray(topk), jnp.asarray(topp), sub,
-                )
-        else:
-            if all_greedy:
-                first, self.pages = self._prefill_chunk_greedy(
-                    self.params, self._tokens_to_device(tokens), jnp.asarray(start),
-                    jnp.asarray(lengths), self.pages, jnp.asarray(tables),
-                )
-            else:
-                self._rng, sub = jax.random.split(self._rng)
-                first, self.pages = self._prefill_chunk_sample(
-                    self.params, self._tokens_to_device(tokens), jnp.asarray(start),
-                    jnp.asarray(lengths), self.pages, jnp.asarray(tables),
-                    jnp.asarray(temp), jnp.asarray(topk),
-                    jnp.asarray(topp), sub,
-                )
+                if all_greedy:
+                    first, self.pages = self._prefill_chunk_greedy(
+                        self.params, self._tokens_to_device(tokens), jnp.asarray(start),
+                        jnp.asarray(lengths), self.pages, jnp.asarray(tables),
+                    )
+                else:
+                    self._rng, sub = jax.random.split(self._rng)
+                    first, self.pages = self._prefill_chunk_sample(
+                        self.params, self._tokens_to_device(tokens), jnp.asarray(start),
+                        jnp.asarray(lengths), self.pages, jnp.asarray(tables),
+                        jnp.asarray(temp), jnp.asarray(topk),
+                        jnp.asarray(topp), sub,
+                    )
+        except Exception as exc:
+            # Host state is still pre-dispatch (no slot occupied, no pages
+            # registered): release this round's pages and requeue the
+            # candidates — bounded, so a deterministic dispatch failure
+            # eventually surfaces to callers instead of spinning.
+            self._record_dispatch_failure(exc)
+            requeue: list[GenerationRequest] = []
+            for _, req, blocks, _ in batch:
+                self.allocator.free(blocks)
+                if req.requeues >= self.ecfg.max_requeues:
+                    self._fail_request(
+                        req, f"prefill dispatch failed: {exc} "
+                             f"(gave up after {req.requeues} requeues)")
+                else:
+                    req.requeues += 1
+                    self.requeues += 1
+                    requeue.append(req)
+            self._pending.extendleft(reversed(requeue))
+            return admitted_long > 0
+        self._record_dispatch_ok()
         if self.prefix_cache is not None:
             for slot_idx, req, blocks, st in batch:
                 self.prefix_cache.register(req.prompt_ids, blocks)
@@ -993,6 +1254,10 @@ class InferenceEngine:
         lanes: list[tuple] = []
         touched: list[_Slot] = []
         final_greedy = True
+        # (slot, chunk_len, became_final) — enough to roll every slot
+        # mutation back if the dispatch itself fails.
+        muts: list[tuple[_Slot, int, bool]] = []
+        to_register: list[_Slot] = []
         for j, (i, s) in enumerate(cands):
             L = len(s.req.prompt_ids)
             n = min(bucket, L - s.prefill_pos)
@@ -1004,32 +1269,51 @@ class InferenceEngine:
             s.prefill_pos += n
             s.inflight_chunks += 1
             touched.append(s)
+            became_final = False
             if s.prefill_pos >= L:
                 # Final chunk: its last-token logits produce the first
                 # generated token; pages for the whole prompt are now in
-                # the dispatch chain, so the prefix becomes publishable.
+                # the dispatch chain, so the prefix becomes publishable
+                # (registered below, only after the dispatch succeeds).
                 s.prefilling = False
+                became_final = True
                 sp = s.req.sampling
                 temp[j], topk[j], topp[j] = sp.temperature, sp.top_k, sp.top_p
                 final_greedy = final_greedy and sp.temperature <= 0.0
                 idx[j] = i
                 lanes.append((j, i, s.req))
                 if self.prefix_cache is not None:
-                    self.prefix_cache.register(s.req.prompt_ids, s.blocks)
+                    to_register.append(s)
+            muts.append((s, n, became_final))
 
-        if final_greedy:
-            first, self.pages = self._prefill_chunk_greedy(
-                self.params, self._tokens_to_device(tokens), jnp.asarray(start),
-                jnp.asarray(lengths), self.pages, jnp.asarray(tables),
-            )
-        else:
-            self._rng, sub = jax.random.split(self._rng)
-            first, self.pages = self._prefill_chunk_sample(
-                self.params, self._tokens_to_device(tokens), jnp.asarray(start),
-                jnp.asarray(lengths), self.pages, jnp.asarray(tables),
-                jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
-                sub,
-            )
+        try:
+            self._faults.maybe_raise("prefill_dispatch")
+            if final_greedy:
+                first, self.pages = self._prefill_chunk_greedy(
+                    self.params, self._tokens_to_device(tokens), jnp.asarray(start),
+                    jnp.asarray(lengths), self.pages, jnp.asarray(tables),
+                )
+            else:
+                self._rng, sub = jax.random.split(self._rng)
+                first, self.pages = self._prefill_chunk_sample(
+                    self.params, self._tokens_to_device(tokens), jnp.asarray(start),
+                    jnp.asarray(lengths), self.pages, jnp.asarray(tables),
+                    jnp.asarray(temp), jnp.asarray(topk), jnp.asarray(topp),
+                    sub,
+                )
+        except Exception as exc:
+            # Nothing reached the device: rewind this round's slot state
+            # so the next step re-dispatches the same chunks.
+            for s, n, became_final in muts:
+                s.prefill_pos -= n
+                s.inflight_chunks -= 1
+                if became_final:
+                    s.prefilling = True
+            self._record_dispatch_failure(exc)
+            return False
+        self._record_dispatch_ok()
+        for s in to_register:
+            self.prefix_cache.register(s.req.prompt_ids, s.blocks)
         self.prefills += len(lanes)
         self._queue_inflight("chunk", first, idx, lanes, touched)
         return True
@@ -1456,6 +1740,34 @@ class InferenceEngine:
 
         eos = jnp.asarray(self.eos_id, jnp.int32)
         all_greedy = all(s.req.sampling.temperature <= 0.0 for _, s in lanes)
+        try:
+            self._faults.maybe_raise("decode_dispatch")
+            payload, kind = self._dispatch_decode_call(
+                spec, all_greedy, lanes, K, ctx, steps_arr, table,
+                temp, topk, topp, eos)
+        except Exception as exc:
+            # Nothing reached the device: undo the in-flight accounting so
+            # the same lanes re-dispatch next step (ctx_pred derives from
+            # inflight_decode, so it rewinds with it).
+            for _, s, steps_i in meta:
+                s.inflight_decode -= steps_i
+            self._record_dispatch_failure(exc)
+            return False
+        self._record_dispatch_ok()
+        if self._faults.should_fire("decode_stuck"):
+            payload = _StuckPayload(payload)
+        self._inflight.append(_Inflight(
+            kind=kind, call_id=self._next_call_id, arr=payload, lanes=meta))
+        self._next_call_id += 1
+        return True
+
+    def _dispatch_decode_call(self, spec: bool, all_greedy: bool, lanes,
+                              K: int, ctx, steps_arr, table, temp, topk,
+                              topp, eos):
+        """The device-call half of :meth:`_dispatch_decode`, split out so
+        the dispatch fault/rollback boundary wraps exactly the program
+        call.  Returns ``(payload, kind)``."""
+        ec = self.ecfg
         if spec:
             # Filters only matter on lanes that actually sample: a greedy
             # lane carrying top_p (a common client default) must not force
@@ -1508,15 +1820,53 @@ class InferenceEngine:
             toks.copy_to_host_async()
         except AttributeError:
             pass
-        self._inflight.append(_Inflight(
-            kind=kind, call_id=self._next_call_id, arr=payload, lanes=meta))
-        self._next_call_id += 1
-        return True
+        return payload, kind
 
     # -- reconciliation -------------------------------------------------
 
     def _reconcile_one(self) -> None:
         call = self._inflight.popleft()
+        budget = self.ecfg.dispatch_timeout_s
+        if budget > 0 and not self._call_ready(call):
+            # Watchdog: poll readiness instead of blocking in np.asarray —
+            # a wedged device call must trip recovery, not hang the loop.
+            t0 = time.monotonic()
+            while not self._call_ready(call):
+                if time.monotonic() - t0 >= budget:
+                    self.watchdog_trips += 1
+                    if self.health is not None:
+                        self.health.record_watchdog_trip()
+                    self._reset_pipeline(
+                        f"dispatch watchdog: {call.kind} call not ready "
+                        f"after {budget:.2f}s", extra_calls=(call,))
+                    return
+                time.sleep(0.002)
+        if self._faults.should_fire("slow_host_callback"):
+            time.sleep(self._faults.delay_s("slow_host_callback"))
+        try:
+            self._apply_call(call)
+        except Exception as exc:
+            # A failed host conversion (device error surfacing, injected
+            # stuck payload with the watchdog off) poisons the donated
+            # buffer chain: reset and recompute.
+            self._record_dispatch_failure(exc)
+            self._reset_pipeline(
+                f"reconcile of {call.kind} call failed: {exc}",
+                extra_calls=(call,))
+            return
+        # Release deferred frees that no in-flight call references anymore.
+        if self._deferred_frees:
+            still = []
+            for after_id, blocks in self._deferred_frees:
+                if after_id <= call.call_id:
+                    self.allocator.free(blocks)
+                else:
+                    still.append((after_id, blocks))
+            self._deferred_frees = still
+
+    def _apply_call(self, call: _Inflight) -> None:
+        """Convert one dispatched call's payload and apply it to slots
+        (token emission, retirement, chunk/decode accounting)."""
         gap_t0 = time.monotonic()
         if call.kind == "spec":
             toks, stats = call.arr
@@ -1577,15 +1927,6 @@ class InferenceEngine:
                 if self._is_finished(s) or (s.cancel_requested
                                             and s.inflight_decode == 0):
                     self._retire(slot_idx)
-        # Release deferred frees that no in-flight call references anymore.
-        if self._deferred_frees:
-            still = []
-            for after_id, blocks in self._deferred_frees:
-                if after_id <= call.call_id:
-                    self.allocator.free(blocks)
-                else:
-                    still.append((after_id, blocks))
-            self._deferred_frees = still
 
     def _observe_ttft(self, ttft_s: float) -> None:
         for i, le in enumerate(self.ttft_buckets):
@@ -1611,10 +1952,16 @@ class InferenceEngine:
         reason = "eos" if toks and toks[-1] == self.eos_id else "length"
         if reason == "eos":
             toks = toks[:-1]
+        error = ""
+        if s.abort_cause:
+            # Deadline-aborted (or otherwise force-failed) slot: the result
+            # carries the cause and whatever tokens were already streamed.
+            reason, error = "error", s.abort_cause
         result = GenerationResult(
             request_id=s.req.request_id,
             token_ids=toks,
             finish_reason=reason,
+            error=error,
             # A slot cancelled mid-prefill retires with no first token.
             ttft_s=(s.first_token_time - s.req.submit_time
                     if s.first_token_time > 0.0 else 0.0),

@@ -42,6 +42,8 @@ import hashlib
 
 import numpy as np
 
+from k8s_llm_monitor_tpu.resilience.faults import get_injector
+
 
 def shareable_blocks(n_tokens: int, block_size: int) -> int:
     """Full blocks of a prompt that may be published for prefix reuse,
@@ -84,6 +86,9 @@ class BlockAllocator:
 
     def alloc(self, num_tokens: int) -> list[int]:
         n = self.blocks_for(num_tokens)
+        if get_injector().should_fire("alloc_exhaustion"):
+            raise OutOfBlocks(
+                f"injected exhaustion: need {n} blocks (fault point)")
         if n > len(self._free):
             raise OutOfBlocks(f"need {n} blocks, {len(self._free)} free")
         out = [self._free.pop() for _ in range(n)]
@@ -96,6 +101,9 @@ class BlockAllocator:
         need = self.blocks_for(new_len) - len(blocks)
         if need <= 0:
             return
+        if get_injector().should_fire("alloc_exhaustion"):
+            raise OutOfBlocks(
+                f"injected exhaustion: need {need} more blocks (fault point)")
         if need > len(self._free):
             raise OutOfBlocks(f"need {need} more blocks, {len(self._free)} free")
         for _ in range(need):

@@ -19,14 +19,33 @@ import atexit
 import itertools
 import queue
 import threading
+import time
 from typing import Iterator, Optional
 
+from k8s_llm_monitor_tpu.resilience.health import HealthMonitor
 from k8s_llm_monitor_tpu.serving.engine import (
     GenerationRequest,
     GenerationResult,
     InferenceEngine,
     SamplingParams,
 )
+
+
+class OverloadedError(Exception):
+    """Admission refused by load shedding (or drain).  Retriable: the
+    caller should back off and retry (HTTP layer maps this to 429/503 with
+    Retry-After).  Carries the backlog evidence so clients and logs see
+    *why* they were shed."""
+
+    def __init__(self, reason: str, queue_depth: int = 0,
+                 queue_tokens: int = 0, retriable: bool = True):
+        super().__init__(
+            f"overloaded: {reason} "
+            f"(queue_depth={queue_depth}, queue_tokens={queue_tokens})")
+        self.reason = reason
+        self.queue_depth = queue_depth
+        self.queue_tokens = queue_tokens
+        self.retriable = retriable
 
 
 class RequestHandle:
@@ -97,16 +116,25 @@ class EngineService:
     submission.  The loop thread is the only toucher of engine state; callers
     talk through a submission queue and per-request handles."""
 
-    def __init__(self, engine: InferenceEngine):
+    def __init__(self, engine: InferenceEngine,
+                 health: HealthMonitor | None = None):
         self.engine = engine
         engine.token_sink = self._sink
+        # One health monitor per service: the engine reports dispatch
+        # failures / watchdog trips into it, submit() reports shed/admit,
+        # and /health + /readyz read it.
+        self.health = health or HealthMonitor()
+        engine.health = self.health
         self._submissions: "queue.Queue[GenerationRequest]" = queue.Queue()
         self._cancels: "queue.Queue[str]" = queue.Queue()
+        self._cancelled: set[str] = set()
         self._handles: dict[str, RequestHandle] = {}
         self._handles_lock = threading.Lock()
         self._ids = itertools.count()
         self._stop = threading.Event()
         self._wake = threading.Event()
+        self._draining = False
+        self.shed_count = 0
         self._dead: str | None = None  # set when the step loop dies
         self._thread = threading.Thread(
             target=self._run, name="engine-service", daemon=True)
@@ -125,9 +153,25 @@ class EngineService:
         prompt_ids: list[int],
         sampling: SamplingParams | None = None,
         request_id: str | None = None,
+        deadline_s: float = 0.0,
     ) -> RequestHandle:
         if self._dead is not None:
             raise RuntimeError(f"engine service is dead: {self._dead}")
+        if self._draining or self._stop.is_set():
+            # Not retriable *here* — this replica is going away; the
+            # client should retry against another replica.
+            self.shed_count += 1
+            self.health.record_shed()
+            raise OverloadedError("draining", retriable=False)
+        reason = self.engine.should_shed()
+        if reason:
+            self.shed_count += 1
+            self.health.record_shed()
+            raise OverloadedError(
+                reason,
+                queue_depth=self.engine.queue_depth,
+                queue_tokens=self.engine.queue_tokens)
+        self.health.record_admit()
         if request_id is None:
             request_id = f"svc-{next(self._ids)}"
         handle = RequestHandle(request_id, self.engine.eos_id,
@@ -138,6 +182,7 @@ class EngineService:
             request_id=request_id,
             prompt_ids=list(prompt_ids),
             sampling=sampling or SamplingParams(),
+            deadline_s=deadline_s,
         ))
         self._wake.set()
         return handle
@@ -162,25 +207,77 @@ class EngineService:
         self._cancels.put(request_id)
         self._wake.set()
 
-    def stop(self, timeout: float = 10.0) -> None:
+    # -- drain / shutdown -----------------------------------------------
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Stop admitting new work (submit() sheds with ``draining``) and
+        wait for queued + inflight requests to finish and their streams to
+        flush.  Returns True when fully drained within ``timeout``."""
+        self._draining = True
+        self.health.set_draining(True)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._handles_lock:
+                idle = not self._handles
+            if (idle and self._submissions.empty()
+                    and not self.engine.has_work):
+                return True
+            time.sleep(0.01)
+        return False
+
+    def stop(self, timeout: float = 10.0, drain_s: float = 0.0) -> None:
+        """Stop the step loop.  ``drain_s > 0`` first drains gracefully
+        (finish inflight, flush streams); any handle still unresolved when
+        the loop exits is failed so no client blocks forever."""
+        if drain_s > 0 and self._dead is None:
+            self.drain(timeout=drain_s)
         self._stop.set()
         self._wake.set()
         self._thread.join(timeout=timeout)
         atexit.unregister(self.stop)
+        if self._dead is None:
+            self._fail_all("service stopped")
 
     # -- loop -----------------------------------------------------------
 
+    def _fail_handle(self, request_id: str, msg: str) -> None:
+        with self._handles_lock:
+            handle = self._handles.pop(request_id, None)
+        if handle is not None:
+            handle._push([], GenerationResult(
+                request_id=request_id, token_ids=[], finish_reason="error",
+                ttft_s=0.0, latency_s=0.0, error=msg,
+            ))
+
     def _drain_submissions(self) -> None:
+        # Cancels first: a cancel aimed at a request still sitting in the
+        # submission queue (never admitted to the engine) must release the
+        # caller immediately, not after a full generation.
         while True:
             try:
-                self.engine.submit(self._submissions.get_nowait())
+                self._cancelled.add(self._cancels.get_nowait())
             except queue.Empty:
                 break
         while True:
             try:
-                self.engine.cancel(self._cancels.get_nowait())
+                req = self._submissions.get_nowait()
             except queue.Empty:
                 break
+            if req.request_id in self._cancelled:
+                self._cancelled.discard(req.request_id)
+                self._fail_handle(req.request_id, "cancelled before admission")
+                continue
+            try:
+                self.engine.submit(req)
+            except ValueError as exc:
+                # Invalid request (empty prompt, bad sampling): fail its
+                # handle instead of killing the step loop.
+                self._fail_handle(req.request_id, str(exc))
+        for rid in list(self._cancelled):
+            # Unknown ids (already finished, duplicate cancel) are dropped;
+            # the handle has already resolved either way.
+            self.engine.cancel(rid)
+            self._cancelled.discard(rid)
 
     def _run(self) -> None:
         while not self._stop.is_set():
@@ -190,6 +287,7 @@ class EngineService:
                     self.engine.step()
                 except Exception as exc:  # engine is corrupt — fail everything
                     self._dead = f"engine step failed: {exc!r}"
+                    self.health.set_dead(self._dead)
                     self._fail_all(self._dead)
                     raise
             else:

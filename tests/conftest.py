@@ -30,6 +30,17 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    # No pytest.ini/pyproject config in this repo: register the markers the
+    # suite selects on so `-m 'not slow'` (tier-1) and `-m chaos` run
+    # without unknown-marker warnings.
+    config.addinivalue_line(
+        "markers", "slow: long-running tests excluded from tier-1")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection resilience tests "
+                   "(tests/test_resilience.py; `make chaos`)")
+
+
 @pytest.fixture(scope="session")
 def cpu_mesh_devices():
     import jax

@@ -405,7 +405,11 @@ def test_spec_inflight_then_sampled_admission(params):
         eng.submit(GenerationRequest(
             f"g{j}", p, SamplingParams(max_tokens=40, temperature=0.0)))
     # Step until a spec call is actually in flight, then inject the
-    # sampled request mid-stream.
+    # sampled request mid-stream.  step()'s opportunistic ready-drain is a
+    # latency optimization, not a correctness requirement — hold it off so
+    # the in-flight call stays observable even when CPU execution
+    # completes before step() returns (machine-speed-dependent otherwise).
+    eng._call_ready = lambda call: False
     for _ in range(50):
         eng.step()
         if any(c.kind == "spec" for c in eng._inflight):
@@ -418,6 +422,7 @@ def test_spec_inflight_then_sampled_admission(params):
     eng.submit(GenerationRequest(
         "s0", list(rng.integers(3, 300, size=5)),
         SamplingParams(max_tokens=8, temperature=0.9, top_p=0.9)))
+    del eng._call_ready
     while eng.has_work:
         eng.step()
     for j, p in enumerate(gp):
