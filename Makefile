@@ -7,7 +7,7 @@ SHELL := /bin/bash    # tier1 uses pipefail/PIPESTATUS
 
 .PHONY: run run-agent run-scheduler demo test test-fast tier1 chaos bench \
         bench-decode dryrun smoke preflight deploy-agent docker \
-        docker-agent docker-scheduler lint clean
+        docker-agent docker-scheduler lint lint-trace clean
 
 run:
 	$(PY) -m k8s_llm_monitor_tpu.cmd.server --cluster fake --port 8081
@@ -72,8 +72,20 @@ docker-agent:
 docker-scheduler:
 	docker build -t k8s-llm-monitor-tpu-scheduler:dev -f Dockerfile.scheduler .
 
-lint:
+LINT_PATHS = k8s_llm_monitor_tpu tests bench.py __graft_entry__.py
+
+lint:               # compileall + graftcheck always; ruff/mypy when installed
 	$(PY) -m compileall -q k8s_llm_monitor_tpu
+	@if $(PY) -c "import ruff" >/dev/null 2>&1; then \
+	  $(PY) -m ruff check $(LINT_PATHS); \
+	else echo "lint: ruff not installed, skipping (config in pyproject.toml)"; fi
+	@if $(PY) -c "import mypy" >/dev/null 2>&1; then \
+	  $(PY) -m mypy --config-file pyproject.toml; \
+	else echo "lint: mypy not installed, skipping (config in pyproject.toml)"; fi
+	$(TEST_ENV) $(PY) -m k8s_llm_monitor_tpu.devtools.graftcheck $(LINT_PATHS)
+
+lint-trace:         # lint + trace-time guards (jit-compiles a tiny engine)
+	$(TEST_ENV) $(PY) -m k8s_llm_monitor_tpu.devtools.graftcheck --trace $(LINT_PATHS)
 
 clean:
 	find . -name __pycache__ -type d -exec rm -rf {} + 2>/dev/null; true

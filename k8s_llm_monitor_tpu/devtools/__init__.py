@@ -1,0 +1,18 @@
+"""graftcheck: JAX-aware static analysis + trace-time correctness gates.
+
+Three passes, one CLI (``python -m k8s_llm_monitor_tpu.devtools.graftcheck``):
+
+  * :mod:`~k8s_llm_monitor_tpu.devtools.astlint` — custom AST rules over the
+    package (host reads inside jit bodies, blocking calls under locks, bare
+    excepts, mutable defaults, fault-point registry);
+  * :mod:`~k8s_llm_monitor_tpu.devtools.traceguard` — jit-traces the engine's
+    hot entry points and asserts compile-count stability, no host-callback
+    ops in the jaxprs, and donated-buffer rebinding;
+  * :mod:`~k8s_llm_monitor_tpu.devtools.lockcheck` — an instrumented-lock
+    mode (``K8SLLM_LOCKCHECK=1``) recording acquisition order, lock-order
+    cycles, long holds, and unguarded shared-state writes.
+
+See docs/devtools.md.  This ``__init__`` is import-free on purpose:
+``lockcheck`` is imported by low-level modules (resilience/faults.py) and
+must never drag jax or the lint machinery in with it.
+"""

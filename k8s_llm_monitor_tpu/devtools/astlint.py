@@ -1,0 +1,463 @@
+"""JAX-aware AST lint: the rules ``compileall`` and pytest cannot see.
+
+The bug classes that dominate risk in this codebase are not syntax errors:
+a ``time.time()`` inside a jit body bakes one timestamp into the compiled
+program forever; a ``requests`` call under the metrics-manager lock stalls
+every ``/metrics`` scrape behind a slow pod; a typo'd fault-point name
+turns a chaos drill into a silent no-op.  Each rule here targets one such
+class.
+
+Rule framework: one class per rule (subclass :class:`Rule`, set ``name``
+and implement ``check``); :data:`ALL_RULES` is the registry.  Suppression:
+
+    x = risky()  # graftcheck: disable=lock-blocking-call -- reason
+
+suppresses the named rule(s) on that line (comma-separated; ``all``
+matches every rule), and a line anywhere in the file
+
+    # graftcheck: disable-file=jit-host-read -- reason
+
+suppresses a rule for the whole file.  A reason after ``--`` is
+conventionally required by review, not enforced.
+
+Used by the graftcheck CLI (human + JSON output) and unit-tested per rule
+in tests/test_graftcheck.py.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import re
+from pathlib import Path
+from typing import Iterable, Iterator
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def human(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: [{self.rule}] {self.message}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class Rule:
+    """One lint rule.  ``check`` yields findings for a parsed module."""
+
+    name = "abstract"
+    description = ""
+
+    def check(self, tree: ast.Module, path: str) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, path: str, node: ast.AST, message: str) -> Finding:
+        return Finding(path=path, line=getattr(node, "lineno", 1),
+                       col=getattr(node, "col_offset", 0),
+                       rule=self.name, message=message)
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+
+def dotted_name(node: ast.AST) -> str:
+    """``a.b.c`` for Name/Attribute chains; "" for anything else."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _is_jit_expr(node: ast.AST) -> bool:
+    """True for expressions denoting jax.jit: ``jit``, ``jax.jit``,
+    ``functools.partial(jax.jit, ...)``, or a call of any of those (a
+    decorator like ``jax.jit(static_argnames=...)``)."""
+    dn = dotted_name(node)
+    if dn == "jit" or dn.endswith(".jit"):
+        return True
+    if isinstance(node, ast.Call):
+        fdn = dotted_name(node.func)
+        if fdn.endswith("partial") and node.args \
+                and _is_jit_expr(node.args[0]):
+            return True
+        return _is_jit_expr(node.func)
+    return False
+
+
+def jit_bodies(tree: ast.Module) -> list[ast.AST]:
+    """Function/lambda nodes whose bodies are jit-traced:
+
+    * defs decorated with ``@jax.jit`` / ``@functools.partial(jax.jit,...)``;
+    * defs later wrapped — any ``jax.jit(fn_name, ...)`` call in the file
+      marks every same-named def (file-local over-approximation; good
+      enough for a lint);
+    * lambdas passed directly to ``jax.jit(...)``.
+    """
+    bodies: list[ast.AST] = []
+    wrapped_names: set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if any(_is_jit_expr(d) for d in node.decorator_list):
+                bodies.append(node)
+        elif isinstance(node, ast.Call) and _is_jit_expr(node.func):
+            for arg in node.args:
+                if isinstance(arg, ast.Name):
+                    wrapped_names.add(arg.id)
+                elif isinstance(arg, ast.Lambda):
+                    bodies.append(arg)
+    if wrapped_names:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and node.name in wrapped_names \
+                    and node not in bodies:
+                bodies.append(node)
+    return bodies
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+class JitHostReadRule(Rule):
+    """No host-state reads inside jit-traced bodies.
+
+    ``time.time()`` / ``os.environ[...]`` / ``random.seed`` executed during
+    tracing bake one Python-time value into the compiled program: every
+    later invocation silently reuses it (or, worse, a changed value
+    triggers a retrace).  Host state belongs outside the jit boundary,
+    passed in as an argument.
+    """
+
+    name = "jit-host-read"
+    description = "host read (clock/env/RNG seed) inside a jit-traced body"
+
+    _CALLS = {
+        "time.time", "time.monotonic", "time.perf_counter", "time.time_ns",
+        "time.monotonic_ns", "datetime.now", "datetime.utcnow",
+        "datetime.datetime.now", "datetime.datetime.utcnow",
+        "os.getenv", "os.environb",
+        "random.seed", "random.random", "random.randint", "random.uniform",
+        "random.choice", "random.randrange", "random.getrandbits",
+        "np.random.seed", "numpy.random.seed",
+    }
+
+    def check(self, tree: ast.Module, path: str) -> Iterator[Finding]:
+        for body in jit_bodies(tree):
+            for node in ast.walk(body):
+                if isinstance(node, ast.Call):
+                    dn = dotted_name(node.func)
+                    if dn in self._CALLS or dn.endswith(".seed"):
+                        yield self.finding(
+                            path, node,
+                            f"'{dn}()' inside a jit-traced body bakes host "
+                            f"state into the compiled program; pass the "
+                            f"value in as an argument")
+                elif isinstance(node, (ast.Attribute, ast.Name)):
+                    if dotted_name(node) == "os.environ":
+                        yield self.finding(
+                            path, node,
+                            "'os.environ' read inside a jit-traced body; "
+                            "resolve env config before the jit boundary")
+
+
+class LockBlockingCallRule(Rule):
+    """No blocking calls while a lock is held.
+
+    A sleep, HTTP request, socket connect, subprocess, or device->host
+    sync under a lock turns every other thread contending for that lock
+    into a convoy — on the serving plane that is the difference between a
+    slow scrape and a wedged step loop.  Move the blocking work outside
+    the critical section (snapshot under the lock, act after release).
+
+    Heuristics: a ``with`` context whose expression's terminal name
+    contains ``lock``, ``mutex``, or ``cond`` is treated as a lock;
+    nested function bodies are skipped (closures usually run later,
+    outside the lock).
+    """
+
+    name = "lock-blocking-call"
+    description = "blocking call (sleep/HTTP/socket/device sync) under a lock"
+
+    _CALLS = {
+        "time.sleep", "sleep",
+        "socket.create_connection", "socket.getaddrinfo",
+        "urllib.request.urlopen", "urlopen",
+        "subprocess.run", "subprocess.Popen", "subprocess.check_output",
+        "subprocess.check_call", "subprocess.call",
+        "jax.device_get",
+    }
+    _REQUESTS_VERBS = {"get", "post", "put", "delete", "head", "patch",
+                       "request"}
+    _METHOD_SUFFIXES = ("block_until_ready",)
+
+    @staticmethod
+    def _is_lock_ctx(expr: ast.AST) -> bool:
+        dn = dotted_name(expr)
+        leaf = dn.rsplit(".", 1)[-1].lower()
+        return any(k in leaf for k in ("lock", "mutex", "cond"))
+
+    def _is_blocking(self, call: ast.Call) -> str:
+        dn = dotted_name(call.func)
+        if dn in self._CALLS:
+            return dn
+        parts = dn.split(".")
+        if len(parts) >= 2 and parts[-2] == "requests" \
+                and parts[-1] in self._REQUESTS_VERBS:
+            return dn
+        if parts[-1] in self._METHOD_SUFFIXES:
+            return dn
+        if parts[-1] == "join" and len(parts) >= 2 \
+                and "thread" in parts[-2].lower():
+            return dn
+        if parts[-1] == "asarray" and len(parts) >= 2 \
+                and parts[-2] in ("np", "numpy", "jnp"):
+            # Device->host sync when the operand is a device array; under
+            # a lock that risk is never worth it.
+            return dn
+        return ""
+
+    def check(self, tree: ast.Module, path: str) -> Iterator[Finding]:
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.With, ast.AsyncWith)):
+                continue
+            if not any(self._is_lock_ctx(item.context_expr)
+                       for item in node.items):
+                continue
+            stack: list[ast.AST] = list(node.body)
+            while stack:
+                sub = stack.pop()
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                    ast.Lambda)):
+                    continue  # runs later, usually outside the lock
+                if isinstance(sub, ast.Call):
+                    dn = self._is_blocking(sub)
+                    if dn:
+                        yield self.finding(
+                            path, sub,
+                            f"blocking call '{dn}' while holding a lock; "
+                            f"move it outside the critical section")
+                stack.extend(ast.iter_child_nodes(sub))
+
+
+class BareExceptRule(Rule):
+    """No bare ``except:`` and no swallowed ``BaseException``.
+
+    Both catch ``KeyboardInterrupt``/``SystemExit`` and — in this codebase
+    — ``FaultError`` injections, turning a chaos drill into a silent pass.
+    Catch ``Exception`` (or narrower), or re-raise.
+    """
+
+    name = "bare-except"
+    description = "bare except / swallowed BaseException"
+
+    @staticmethod
+    def _reraises(handler: ast.ExceptHandler) -> bool:
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Raise):
+                return True
+        return False
+
+    def check(self, tree: ast.Module, path: str) -> Iterator[Finding]:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if node.type is None:
+                if not self._reraises(node):
+                    yield self.finding(
+                        path, node,
+                        "bare 'except:' swallows BaseException (incl. "
+                        "KeyboardInterrupt and injected faults); catch "
+                        "Exception or re-raise")
+            elif dotted_name(node.type).endswith("BaseException"):
+                if not self._reraises(node):
+                    yield self.finding(
+                        path, node,
+                        "'except BaseException' without re-raise; catch "
+                        "Exception or re-raise")
+
+
+class MutableDefaultRule(Rule):
+    """No mutable default arguments (shared across calls)."""
+
+    name = "mutable-default"
+    description = "mutable default argument"
+
+    _MUTABLE_CALLS = {"list", "dict", "set", "defaultdict", "deque",
+                      "Counter", "OrderedDict"}
+
+    def _is_mutable(self, node: ast.AST) -> bool:
+        if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                             ast.DictComp, ast.SetComp)):
+            return True
+        if isinstance(node, ast.Call):
+            dn = dotted_name(node.func)
+            return dn.rsplit(".", 1)[-1] in self._MUTABLE_CALLS
+        return False
+
+    def check(self, tree: ast.Module, path: str) -> Iterator[Finding]:
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.Lambda)):
+                continue
+            args = node.args
+            for default in list(args.defaults) + [
+                    d for d in args.kw_defaults if d is not None]:
+                if self._is_mutable(default):
+                    yield self.finding(
+                        path, default,
+                        "mutable default argument is shared across calls; "
+                        "default to None (or a dataclasses.field factory)")
+
+
+class FaultPointRule(Rule):
+    """Every fault-point name must exist in the central registry.
+
+    ``get_injector().arm("decode_dispach")`` (typo) raises at arm time,
+    but hooks like ``self._faults.maybe_raise("decode_dispach")`` planted
+    in rarely-exercised paths would just never fire.  This rule checks
+    every string literal passed to the injector API against
+    ``resilience.faults.FAULT_POINTS``.
+    """
+
+    name = "fault-point"
+    description = "fault-point name not in resilience.faults.FAULT_POINTS"
+
+    _ALWAYS = {"maybe_raise", "should_fire", "delay_s"}
+    _HINTED = {"arm", "disarm", "fired"}
+    _RECEIVER_HINTS = ("fault", "injector", "inj")
+
+    def __init__(self, points: frozenset[str] | None = None):
+        if points is None:
+            from k8s_llm_monitor_tpu.resilience.faults import FAULT_POINTS
+            points = FAULT_POINTS
+        self._points = points
+
+    def check(self, tree: ast.Module, path: str) -> Iterator[Finding]:
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            if attr not in self._ALWAYS and attr not in self._HINTED:
+                continue
+            if attr in self._HINTED:
+                recv = dotted_name(node.func.value).lower()
+                if isinstance(node.func.value, ast.Call):
+                    recv = dotted_name(node.func.value.func).lower()
+                if not any(h in recv for h in self._RECEIVER_HINTS):
+                    continue
+            if not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                if arg.value not in self._points:
+                    yield self.finding(
+                        path, arg,
+                        f"fault point {arg.value!r} is not declared in "
+                        f"resilience.faults.FAULT_POINTS — a typo here "
+                        f"makes the hook silently never fire")
+
+
+def default_rules() -> list[Rule]:
+    return [JitHostReadRule(), LockBlockingCallRule(), BareExceptRule(),
+            MutableDefaultRule(), FaultPointRule()]
+
+
+ALL_RULE_NAMES = tuple(r.name for r in default_rules())
+
+
+# ---------------------------------------------------------------------------
+# suppression + driver
+# ---------------------------------------------------------------------------
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftcheck:\s*(disable|disable-file)\s*=\s*([\w,\-]+)")
+
+
+def _suppressions(src: str) -> tuple[dict[int, set[str]], set[str]]:
+    """(per-line rule sets, whole-file rule set) from magic comments."""
+    per_line: dict[int, set[str]] = {}
+    per_file: set[str] = set()
+    for lineno, line in enumerate(src.splitlines(), start=1):
+        m = _SUPPRESS_RE.search(line)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(2).split(",") if r.strip()}
+        if m.group(1) == "disable-file":
+            per_file |= rules
+        else:
+            per_line.setdefault(lineno, set()).update(rules)
+    return per_line, per_file
+
+
+def lint_source(src: str, path: str = "<string>",
+                rules: Iterable[Rule] | None = None) -> list[Finding]:
+    """Lint one source blob; returns unsuppressed findings sorted by
+    position.  Syntax errors come back as a single ``parse-error``
+    finding (compileall-grade breakage still surfaces through the lint)."""
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as exc:
+        return [Finding(path=path, line=exc.lineno or 1,
+                        col=exc.offset or 0, rule="parse-error",
+                        message=str(exc.msg))]
+    per_line, per_file = _suppressions(src)
+    out: list[Finding] = []
+    for rule in (rules if rules is not None else default_rules()):
+        for f in rule.check(tree, path):
+            if f.rule in per_file or "all" in per_file:
+                continue
+            line_rules = per_line.get(f.line, set())
+            if f.rule in line_rules or "all" in line_rules:
+                continue
+            out.append(f)
+    out.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return out
+
+
+def iter_py_files(root: Path) -> Iterator[Path]:
+    if root.is_file():
+        if root.suffix == ".py":
+            yield root
+        return
+    for p in sorted(root.rglob("*.py")):
+        if "__pycache__" in p.parts:
+            continue
+        yield p
+
+
+def lint_paths(paths: Iterable[Path],
+               rules: Iterable[Rule] | None = None) -> list[Finding]:
+    rules = list(rules) if rules is not None else default_rules()
+    findings: list[Finding] = []
+    for root in paths:
+        for p in iter_py_files(Path(root)):
+            findings.extend(
+                lint_source(p.read_text(encoding="utf-8"), str(p), rules))
+    return findings
+
+
+def render(findings: list[Finding], as_json: bool = False) -> str:
+    if as_json:
+        return json.dumps({
+            "findings": [f.as_dict() for f in findings],
+            "count": len(findings),
+            "ok": not findings,
+        }, indent=2)
+    if not findings:
+        return "graftcheck astlint: clean"
+    lines = [f.human() for f in findings]
+    lines.append(f"graftcheck astlint: {len(findings)} finding(s)")
+    return "\n".join(lines)

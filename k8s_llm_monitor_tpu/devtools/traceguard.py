@@ -1,0 +1,320 @@
+"""Trace-time correctness gates for the serving engine's hot entry points.
+
+Three guarantees, asserted by *running* the jit machinery on a tiny model
+(CPU-friendly shapes, both the fused-interpret and gather decode paths):
+
+  1. **Compile-count stability** — after a warm-up pass, re-invoking the
+     engine with same-bucket shapes triggers ZERO new compilations.  A
+     silent recompile on the decode hot path costs seconds per occurrence
+     in production; this guard turns it into a red gate.  Counted two
+     ways: the sum of ``_cache_size()`` over every jitted engine program
+     (deterministic, the gating signal) and ``jax.monitoring`` backend
+     compile events (supporting evidence in the report).
+  2. **No host callbacks in the traced programs** — the jaxprs of the
+     decode/prefill/sampling programs must contain no ``pure_callback`` /
+     ``io_callback`` / ``debug_callback`` ops: any of those forces a
+     device->host round-trip inside what the engine treats as an async
+     device call, defeating dispatch-ahead.
+  3. **Donated buffers are rebound** — the engine donates KV pages and the
+     token buffer into every dispatch; after a step the engine must hold
+     the *new* arrays, never a stale alias of a donated input (on TPU that
+     alias is a deleted buffer; on CPU it silently reads garbage-to-be).
+
+The report is machine-readable (dict / JSON) and consumed by
+tests/test_graftcheck.py and the graftcheck CLI (``--trace``).
+
+Everything imports lazily so the CLI can pin ``JAX_PLATFORMS=cpu`` before
+jax initializes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Any, Callable
+
+FORBIDDEN_PRIMITIVES = frozenset({
+    "pure_callback", "io_callback", "debug_callback",
+})
+
+#: Decode paths the guard exercises by default.  "fused" runs the Pallas
+#: kernel in interpreter mode off-TPU — same trace, same jaxpr, no TPU
+#: needed; "gather" is the XLA fallback (and the numerics oracle).
+DEFAULT_PATHS = ("gather", "fused")
+
+
+def force_cpu() -> None:
+    """Pin jax to CPU before any backend initializes (the environment's
+    sitecustomize may otherwise route to a tunneled TPU — see
+    tests/conftest.py for the same dance)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def _tiny_cfg(fused: bool):
+    """Model configs mirroring tests/test_fused_decode.py: one fails the
+    Mosaic 128-lane gate (gather-only), one passes it (KVH*D = 2*64)."""
+    from k8s_llm_monitor_tpu.models.config import ModelConfig
+
+    if fused:
+        return ModelConfig(name="tg-fused", vocab_size=128, hidden_size=256,
+                           intermediate_size=256, num_layers=1, num_heads=4,
+                           num_kv_heads=2, dtype="float32",
+                           rope_theta=10_000.0)
+    return ModelConfig(name="tg", vocab_size=256, hidden_size=32,
+                       intermediate_size=64, num_layers=2, num_heads=4,
+                       num_kv_heads=2, dtype="float32", rope_theta=10_000.0)
+
+
+def build_engine(decode_path: str = "gather", seed: int = 0):
+    """A tiny engine wired for deterministic compile accounting: prefix
+    cache off (a second same-prefix prompt would switch admission to the
+    chunked program — a *legitimate* new compile the guard must not count),
+    speculation off, two buckets."""
+    import jax
+
+    from k8s_llm_monitor_tpu.models import llama
+    from k8s_llm_monitor_tpu.ops.attention import select_decode_impl
+    from k8s_llm_monitor_tpu.serving.engine import EngineConfig, InferenceEngine
+
+    cfg = _tiny_cfg(fused=decode_path == "fused")
+    params = llama.init_params(jax.random.PRNGKey(seed), cfg)
+    ec = EngineConfig(
+        max_slots=4, num_blocks=64, block_size=8, max_blocks_per_seq=8,
+        prefill_buckets=(16, 32), max_prefills_per_step=2,
+        max_admission_rounds=2, decode_steps_per_iter=4, max_inflight=2,
+        spec_k=0, prefix_cache_entries=0, sample_topk_cap=8,
+    )
+    impl = select_decode_impl(cfg=cfg, mode=decode_path)
+    return InferenceEngine(cfg, params, engine_cfg=ec, eos_id=-1,
+                           attn_impl=impl)
+
+
+# ---------------------------------------------------------------------------
+# compile accounting
+# ---------------------------------------------------------------------------
+
+def _engine_programs(engine) -> list[Any]:
+    progs = [engine._prefill_sample, engine._prefill_greedy,
+             engine._prefill_chunk_sample, engine._prefill_chunk_greedy,
+             engine._place_tokens]
+    if engine._hist_place is not None:
+        progs.append(engine._hist_place)
+    progs.extend(engine._decode_cache.values())
+    return progs
+
+
+def program_cache_size(engine) -> int:
+    """Total compiled-variant count across every jitted engine program.
+    The delta across a workload is the number of new compilations it
+    triggered — deterministic, unlike wall-clock or log scraping."""
+    total = 0
+    for prog in _engine_programs(engine):
+        size = getattr(prog, "_cache_size", None)
+        if callable(size):
+            total += size()
+    return total
+
+
+class CompileEvents:
+    """Context manager counting backend-compile events via jax.monitoring
+    (supporting evidence beside the cache-size delta; the persistent
+    compilation cache can serve hits that still emit cache events, so
+    this is reported but not gated on)."""
+
+    _COMPILE_MARKERS = ("compile", "backend_compile")
+
+    def __init__(self):
+        self.events: list[str] = []
+
+    def _listener(self, event: str, **kwargs) -> None:
+        if any(m in event for m in self._COMPILE_MARKERS):
+            self.events.append(event)
+
+    def __enter__(self) -> "CompileEvents":
+        import jax.monitoring
+
+        jax.monitoring.register_event_listener(self._listener)
+        return self
+
+    def __exit__(self, *exc) -> None:
+        try:
+            from jax._src import monitoring as _m
+
+            _m._unregister_event_listener_by_callback(self._listener)
+        except Exception:
+            # jax-internal unregister moved; dropping every listener is
+            # acceptable in the CLI/test contexts this runs in.
+            import jax.monitoring
+
+            jax.monitoring.clear_event_listeners()
+
+    @property
+    def count(self) -> int:
+        return len(self.events)
+
+
+def count_new_compiles(engine, fn: Callable[[], Any]) -> tuple[int, int]:
+    """Run ``fn`` and return (new compiled variants, monitoring events).
+    The first number is the gate; the second is evidence."""
+    before = program_cache_size(engine)
+    with CompileEvents() as ev:
+        fn()
+    return program_cache_size(engine) - before, ev.count
+
+
+# ---------------------------------------------------------------------------
+# jaxpr scanning
+# ---------------------------------------------------------------------------
+
+def _iter_eqns(jaxpr):
+    """Every equation in ``jaxpr``, descending into sub-jaxprs carried in
+    eqn params (pjit bodies, scan bodies, cond branches...)."""
+    closed = getattr(jaxpr, "jaxpr", None)
+    if closed is not None:           # ClosedJaxpr -> Jaxpr
+        jaxpr = closed
+    for eqn in jaxpr.eqns:
+        yield eqn
+        for val in eqn.params.values():
+            for sub in (val if isinstance(val, (list, tuple)) else (val,)):
+                if hasattr(sub, "eqns") or hasattr(sub, "jaxpr"):
+                    yield from _iter_eqns(sub)
+
+
+def forbidden_ops(jaxpr) -> list[str]:
+    return sorted({eqn.primitive.name for eqn in _iter_eqns(jaxpr)
+                   if eqn.primitive.name in FORBIDDEN_PRIMITIVES})
+
+
+def scan_engine_programs(engine) -> dict[str, list[str]]:
+    """make_jaxpr every hot entry point (decode greedy + sampled, prefill
+    greedy + sampled) with engine-shaped arguments and report any
+    forbidden host-callback primitives, keyed by program name."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    ec = engine.ecfg
+    B = ec.max_slots
+    bucket = ec.prefill_buckets[0]
+    W = ec.max_blocks_per_seq
+    pages = engine.pages
+    params = engine.params
+    out: dict[str, list[str]] = {}
+
+    dec_tables = jnp.asarray(np.tile(
+        np.arange(1, W + 1, dtype=np.int32)[None, :], (B, 1)))
+    tok = jnp.zeros((B,), jnp.int32)
+    ctx = jnp.ones((B,), jnp.int32)
+    remaining = jnp.full((B,), 8, jnp.int32)
+    eos = jnp.asarray(-1, jnp.int32)
+    K = ec.decode_steps_per_iter
+
+    greedy = engine._decode_program(K, sampled=False)
+    out["decode_greedy"] = forbidden_ops(jax.make_jaxpr(greedy)(
+        params, tok, ctx, remaining, pages, dec_tables, eos))
+
+    sampled = engine._decode_program(K, sampled=True,
+                                     bounded=ec.sample_topk_cap > 0)
+    temp = jnp.full((B,), 0.7, jnp.float32)
+    topk = jnp.full((B,), 4, jnp.int32)
+    topp = jnp.full((B,), 0.9, jnp.float32)
+    rng = jax.random.PRNGKey(0)
+    out["decode_sampled"] = forbidden_ops(jax.make_jaxpr(sampled)(
+        params, tok, ctx, remaining, pages, dec_tables, temp, topk, topp,
+        rng, eos))
+
+    P = 1
+    ptoks = jnp.zeros((P, bucket), jnp.int32)
+    plens = jnp.full((P,), bucket, jnp.int32)
+    ptbl = jnp.asarray(np.arange(1, W + 1, dtype=np.int32)[None, :])
+    out["prefill_greedy"] = forbidden_ops(jax.make_jaxpr(
+        engine._prefill_greedy)(params, ptoks, plens, pages, ptbl))
+    out["prefill_sampled"] = forbidden_ops(jax.make_jaxpr(
+        engine._prefill_sample)(
+            params, ptoks, plens, pages, ptbl,
+            jnp.full((P,), 0.7, jnp.float32), jnp.full((P,), 4, jnp.int32),
+            jnp.full((P,), 0.9, jnp.float32), rng))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the guard
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class PathReport:
+    decode_path: str
+    warm_compiles: int
+    warm_events: int
+    repeat_compiles: int
+    repeat_events: int
+    forbidden: dict[str, list[str]]
+    donated_pages_rebound: bool
+    donated_tokens_rebound: bool
+
+    @property
+    def ok(self) -> bool:
+        return (self.repeat_compiles == 0
+                and not any(self.forbidden.values())
+                and self.donated_pages_rebound
+                and self.donated_tokens_rebound)
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["ok"] = self.ok
+        return d
+
+
+def _drive(engine, prompt_len: int, greedy: bool, tag: int) -> None:
+    """One generation in the first prefill bucket: 4 tokens, distinct
+    prompt content per ``tag`` (same shapes, different values — content
+    must never matter to the compile count)."""
+    from k8s_llm_monitor_tpu.serving.engine import SamplingParams
+
+    prompt = [(tag * 7 + i) % 100 + 1 for i in range(prompt_len)]
+    sampling = (SamplingParams(max_tokens=4) if greedy
+                else SamplingParams(max_tokens=4, temperature=0.7, top_k=4))
+    res = engine.generate([prompt], sampling)[0]
+    assert res.finish_reason in ("eos", "length"), res
+
+
+def check_path(decode_path: str) -> PathReport:
+    engine = build_engine(decode_path)
+
+    def warm():
+        _drive(engine, prompt_len=12, greedy=True, tag=1)
+        _drive(engine, prompt_len=12, greedy=False, tag=2)
+
+    def repeat():
+        _drive(engine, prompt_len=12, greedy=True, tag=3)
+        _drive(engine, prompt_len=12, greedy=False, tag=4)
+
+    warm_c, warm_e = count_new_compiles(engine, warm)
+    pages_before = engine.pages
+    toks_before = engine._tok_state
+    repeat_c, repeat_e = count_new_compiles(engine, repeat)
+    report = PathReport(
+        decode_path=decode_path,
+        warm_compiles=warm_c, warm_events=warm_e,
+        repeat_compiles=repeat_c, repeat_events=repeat_e,
+        forbidden=scan_engine_programs(engine),
+        # The engine donates pages and the token buffer into every decode
+        # dispatch; after the repeat pass it must hold fresh outputs, not
+        # an alias of something it donated away.
+        donated_pages_rebound=engine.pages is not pages_before,
+        donated_tokens_rebound=engine._tok_state is not toks_before,
+    )
+    return report
+
+
+def run_traceguard(paths=DEFAULT_PATHS) -> dict:
+    """The full trace-time gate; returns the machine-readable report the
+    CLI prints and tests consume."""
+    reports = {p: check_path(p) for p in paths}
+    return {
+        "paths": {p: r.as_dict() for p, r in reports.items()},
+        "ok": all(r.ok for r in reports.values()),
+    }

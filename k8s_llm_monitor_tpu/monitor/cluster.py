@@ -28,6 +28,7 @@ import threading
 from datetime import datetime, timezone
 from typing import Any, Callable, Iterator
 
+from k8s_llm_monitor_tpu.devtools.lockcheck import make_lock
 from k8s_llm_monitor_tpu.monitor.models import rfc3339, utcnow
 
 # ---------------------------------------------------------------------------
@@ -261,7 +262,7 @@ class FakeCluster(ClusterBackend):
     """
 
     def __init__(self, version: str = "v1.29.0-fake") -> None:
-        self._lock = threading.RLock()
+        self._lock = make_lock("fake_cluster", reentrant=True)
         self._version = version
         self._nodes: dict[str, dict] = {}
         self._pods: dict[tuple[str, str], dict] = {}  # (ns, name)

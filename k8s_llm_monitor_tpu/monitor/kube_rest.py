@@ -45,6 +45,7 @@ from typing import Any, Iterator
 
 import yaml
 
+from k8s_llm_monitor_tpu.devtools.lockcheck import make_lock
 from k8s_llm_monitor_tpu.monitor.cluster import (
     ClusterBackend,
     ClusterError,
@@ -197,7 +198,7 @@ class KubeRestBackend(ClusterBackend):
         self._tmpfiles: list[str] = []
         # Live watch streams; close() severs them so blocked reader
         # threads exit instead of outliving the backend.
-        self._streams_lock = threading.Lock()
+        self._streams_lock = make_lock("kube.streams")
         self._streams: list[_HttpWatchStream] = []
 
     def close(self) -> None:

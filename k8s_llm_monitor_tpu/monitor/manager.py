@@ -17,6 +17,7 @@ import time
 from datetime import datetime
 from typing import Any
 
+from k8s_llm_monitor_tpu.devtools.lockcheck import guarded_by, make_lock
 from k8s_llm_monitor_tpu.monitor.client import Client
 from k8s_llm_monitor_tpu.monitor.config import MetricsConfig
 from k8s_llm_monitor_tpu.monitor.metrics_types import (
@@ -49,6 +50,8 @@ class CollectError(Exception):
     pass
 
 
+@guarded_by("_lock", "_snapshot", "_uav_snapshot",
+            "collect_count", "last_collect_duration")
 class Manager:
     """Owns the sources and the latest ``MetricsSnapshot``."""
 
@@ -89,13 +92,15 @@ class Manager:
             else None
         )
 
-        self._lock = threading.RLock()
         self._snapshot = MetricsSnapshot(cluster_metrics=ClusterMetrics())
         self._uav_snapshot: dict[str, dict[str, Any]] = {}
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self.collect_count = 0
         self.last_collect_duration = 0.0
+        # Created last: lockcheck's guarded_by treats writes before the
+        # lock exists as construction, not races.
+        self._lock = make_lock("manager.snapshot", reentrant=True)
 
     # -- lifecycle (ref manager.go:137-192) ------------------------------------
 
@@ -223,9 +228,12 @@ class Manager:
                     ):
                         merged[node] = existing
                 self._uav_snapshot = merged
-
-        self.last_collect_duration = time.monotonic() - start
-        self.collect_count += 1
+            # Counters live under the same lock as the snapshot: status
+            # readers report (snapshot, collect_count, duration) as one
+            # consistent triple.  Writing them outside the lock raced the
+            # readers — lockcheck's guarded_by caught this.
+            self.last_collect_duration = time.monotonic() - start
+            self.collect_count += 1
         logger.info(
             "metrics collection completed in %.2fs (nodes: %d, pods: %d, network: %d, uavs: %d)",
             self.last_collect_duration,

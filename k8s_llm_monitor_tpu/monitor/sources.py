@@ -23,6 +23,7 @@ import threading
 import urllib.request
 from typing import Any, Callable
 
+from k8s_llm_monitor_tpu.devtools.lockcheck import make_lock
 from k8s_llm_monitor_tpu.monitor.client import Client
 from k8s_llm_monitor_tpu.monitor.cluster import (
     ClusterError,
@@ -345,7 +346,7 @@ class UAVMetricsSource:
     def collect(self) -> dict[str, dict[str, Any]]:
         """node name → raw UAV state dict (ref uav_metrics.go:62-172)."""
         out: dict[str, dict[str, Any]] = {}
-        lock = threading.Lock()
+        lock = make_lock("uav_source.merge")
 
         def pull(pod) -> None:
             url = f"http://{pod.ip}:{self.port}/api/v1/state"

@@ -18,6 +18,7 @@ from dataclasses import dataclass, field
 from datetime import datetime
 from typing import Any
 
+from k8s_llm_monitor_tpu.devtools.lockcheck import make_lock
 from k8s_llm_monitor_tpu.monitor.models import to_jsonable, utcnow
 
 UPDATE_RATE_HZ = 10.0  # ref mavlink_simulator.go:172
@@ -151,7 +152,7 @@ class MAVLinkSimulator:
                 }
             ),
         )
-        self._lock = threading.RLock()
+        self._lock = make_lock("uav.sim", reentrant=True)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
         self._elapsed = 0.0

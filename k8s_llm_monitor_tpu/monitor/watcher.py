@@ -27,6 +27,7 @@ from k8s_llm_monitor_tpu.monitor.client import (
     convert_pod,
     convert_service,
 )
+from k8s_llm_monitor_tpu.devtools.lockcheck import make_lock
 from k8s_llm_monitor_tpu.monitor.cluster import ClusterError, WatchStream
 from k8s_llm_monitor_tpu.monitor.models import (
     CRDEvent,
@@ -87,7 +88,7 @@ class Watcher:
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
         self._streams: list[WatchStream] = []
-        self._lock = threading.Lock()
+        self._lock = make_lock("watcher.streams")
 
     def start(self) -> None:
         for ns in self.namespaces:
@@ -224,7 +225,7 @@ class CRDWatcher:
         self.reconnect_delay = reconnect_delay
         self.backoff = _reconnect_backoff(reconnect_delay)
         self._stop = threading.Event()
-        self._lock = threading.Lock()
+        self._lock = make_lock("crd_watcher.state")
         self._threads: list[threading.Thread] = []
         self._streams: list[WatchStream] = []
         self._cr_watched: set[str] = set()  # crd metadata.name

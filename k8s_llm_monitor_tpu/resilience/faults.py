@@ -22,8 +22,9 @@ from __future__ import annotations
 
 import os
 import random
-import threading
 from dataclasses import dataclass
+
+from k8s_llm_monitor_tpu.devtools.lockcheck import make_lock
 
 # The registry of failure points layers may hook.  Hooks for unknown names
 # raise immediately — a typo'd point name must fail the test that armed it,
@@ -65,7 +66,7 @@ class FaultInjector:
     """Named-failure-point registry.  Thread-safe; cheap when disarmed."""
 
     def __init__(self, seed: int = 0):
-        self._lock = threading.Lock()
+        self._lock = make_lock("faults.injector")
         self._rng = random.Random(seed)
         self._points: dict[str, _Point] = {}
         self._load_env()
@@ -155,7 +156,7 @@ class FaultInjector:
 
 
 _injector: FaultInjector | None = None
-_injector_lock = threading.Lock()
+_injector_lock = make_lock("faults.global_init")
 
 
 def get_injector() -> FaultInjector:

@@ -22,8 +22,9 @@ transitions deterministically without sleeping.
 from __future__ import annotations
 
 import collections
-import threading
 import time
+
+from k8s_llm_monitor_tpu.devtools.lockcheck import guarded_by, make_lock
 
 HEALTHY = "healthy"
 DEGRADED = "degraded"
@@ -34,6 +35,8 @@ UNHEALTHY = "unhealthy"
 READY_STATES = (HEALTHY, DEGRADED)
 
 
+@guarded_by("_lock", "_draining", "_dead_reason", "_consecutive_failures",
+            "watchdog_trips", "dispatch_failures", "sheds", "admits")
 class HealthMonitor:
     """Aggregates resilience events into the probe-facing health state."""
 
@@ -43,7 +46,6 @@ class HealthMonitor:
         self.degraded_shed_rate = degraded_shed_rate
         self.unhealthy_failures = unhealthy_failures
         self._clock = clock
-        self._lock = threading.Lock()
         self._draining = False
         self._dead_reason: str | None = None
         self._consecutive_failures = 0
@@ -57,6 +59,9 @@ class HealthMonitor:
         self.dispatch_failures = 0
         self.sheds = 0
         self.admits = 0
+        # Created last: lockcheck's guarded_by treats writes before the
+        # lock exists as construction, not races.
+        self._lock = make_lock("resilience.health")
 
     # -- event intake ---------------------------------------------------
 

@@ -13,8 +13,9 @@ tests replay identically and never sleep real wall-clock time.
 from __future__ import annotations
 
 import random
-import threading
 import time
+
+from k8s_llm_monitor_tpu.devtools.lockcheck import guarded_by, make_lock
 
 
 class Backoff:
@@ -60,6 +61,8 @@ class CircuitOpen(Exception):
         self.remaining_s = remaining_s
 
 
+@guarded_by("_lock", "_consecutive", "_opened_at", "_probing",
+            "trips", "rejections")
 class CircuitBreaker:
     """Consecutive-failure breaker with a half-open probe.
 
@@ -78,12 +81,14 @@ class CircuitBreaker:
         self.failure_threshold = failure_threshold
         self.cooldown_s = cooldown_s
         self._clock = clock
-        self._lock = threading.Lock()
         self._consecutive = 0
         self._opened_at: float | None = None
         self._probing = False
         self.trips = 0           # times the breaker opened
         self.rejections = 0      # calls refused while open
+        # Created last: lockcheck's guarded_by treats writes before the
+        # lock exists as construction, not races.
+        self._lock = make_lock("resilience.breaker")
 
     @property
     def state(self) -> str:

@@ -22,6 +22,7 @@ import threading
 import time
 from typing import Iterator, Optional
 
+from k8s_llm_monitor_tpu.devtools.lockcheck import make_lock
 from k8s_llm_monitor_tpu.resilience.health import HealthMonitor
 from k8s_llm_monitor_tpu.serving.engine import (
     GenerationRequest,
@@ -129,7 +130,7 @@ class EngineService:
         self._cancels: "queue.Queue[str]" = queue.Queue()
         self._cancelled: set[str] = set()
         self._handles: dict[str, RequestHandle] = {}
-        self._handles_lock = threading.Lock()
+        self._handles_lock = make_lock("service.handles")
         self._ids = itertools.count()
         self._stop = threading.Event()
         self._wake = threading.Event()

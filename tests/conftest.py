@@ -29,16 +29,33 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+# slow/chaos markers are registered in pyproject.toml [tool.pytest.ini_options].
 
-def pytest_configure(config):
-    # No pytest.ini/pyproject config in this repo: register the markers the
-    # suite selects on so `-m 'not slow'` (tier-1) and `-m chaos` run
-    # without unknown-marker warnings.
-    config.addinivalue_line(
-        "markers", "slow: long-running tests excluded from tier-1")
-    config.addinivalue_line(
-        "markers", "chaos: fault-injection resilience tests "
-                   "(tests/test_resilience.py; `make chaos`)")
+
+def pytest_sessionfinish(session, exitstatus):
+    # Lock-discipline gate: when the suite ran with K8SLLM_LOCKCHECK=1
+    # (e.g. `K8SLLM_LOCKCHECK=1 make chaos`), a dirty lockcheck registry
+    # (cycles in the acquisition-order graph, unguarded writes to
+    # guarded_by fields, release-by-non-owner) fails the whole session
+    # even if every individual test passed.
+    from k8s_llm_monitor_tpu.devtools import lockcheck
+
+    if not lockcheck.enabled():
+        return
+    report = lockcheck.registry().report()
+    tr = session.config.pluginmanager.get_plugin("terminalreporter")
+    if tr is not None:
+        tr.write_line(
+            f"lockcheck: {len(report['locks'])} instrumented lock(s), "
+            f"{len(report['order_edges'])} order edge(s), "
+            f"{len(report['cycles'])} cycle(s), "
+            f"{len(report['unguarded_writes'])} unguarded write(s), "
+            f"{len(report['long_holds'])} long hold(s)")
+    if not report["ok"]:
+        import json
+
+        print(json.dumps(report, indent=2, default=str))
+        session.exitstatus = 1
 
 
 @pytest.fixture(scope="session")

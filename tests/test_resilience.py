@@ -125,7 +125,7 @@ def test_injector_rate_times_after_determinism():
 def test_injector_rejects_unknown_point_and_bad_env(monkeypatch):
     inj = FaultInjector()
     with pytest.raises(ValueError, match="unknown fault point"):
-        inj.arm("no_such_point")
+        inj.arm("no_such_point")  # graftcheck: disable=fault-point -- deliberately unknown (tests the registry guard)
 
     monkeypatch.setenv("K8SLLM_FAULTS", "decode_dispatch:0.5,kube_http_5xx")
     env_inj = FaultInjector()
