@@ -5,9 +5,10 @@ PY ?= python
 TEST_ENV = env PYTHONPATH= JAX_PLATFORMS=cpu
 SHELL := /bin/bash    # tier1 uses pipefail/PIPESTATUS
 
-.PHONY: run run-agent run-scheduler demo test test-fast tier1 chaos bench \
-        bench-decode dryrun smoke preflight deploy-agent docker \
-        docker-agent docker-scheduler lint lint-trace clean
+.PHONY: run run-agent run-scheduler demo test test-fast tier1 chaos \
+        chaos-lifecycle bench bench-decode dryrun smoke preflight \
+        deploy-agent docker docker-agent docker-scheduler lint lint-trace \
+        clean
 
 run:
 	$(PY) -m k8s_llm_monitor_tpu.cmd.server --cluster fake --port 8081
@@ -43,6 +44,12 @@ tier1:              # the driver's verify gate, verbatim (ROADMAP.md)
 
 chaos:              # fault-injection resilience suite (docs/resilience.md)
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m chaos -p no:cacheprovider
+
+# Crash-safe lifecycle acceptance: WAL + supervisor + handover, with lock
+# discipline checked and journal fsync off (CI speed).
+chaos-lifecycle:
+	$(TEST_ENV) K8SLLM_LOCKCHECK=1 K8SLLM_JOURNAL_FSYNC=never \
+	  $(PY) -m pytest tests/test_lifecycle.py -q -p no:cacheprovider
 
 bench:
 	$(PY) bench.py

@@ -1002,6 +1002,101 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001 — extras never fail the bench
         log(f"query E2E leg skipped: {exc}")
 
+    # --- warm-restart leg: crash-safe lifecycle handover cost (PR 4).
+    # Kills the step loop under streaming load and measures death-detected
+    # -> first replayed token reaching a caller: supervisor teardown +
+    # engine rebuild via the factory + journal-trimmed re-admission
+    # (docs/resilience.md).  Small dedicated engine; a pre-kill warm build
+    # on the same shapes keeps jit compiles out of the measured window.
+    restart_to_token_ms = restart_replayed = None
+    try:
+        import tempfile
+        import threading as _th
+
+        from k8s_llm_monitor_tpu.resilience.faults import get_injector
+        from k8s_llm_monitor_tpu.resilience.journal import RequestJournal
+        from k8s_llm_monitor_tpu.resilience.retry import Backoff
+        from k8s_llm_monitor_tpu.serving.supervisor import EngineSupervisor
+
+        r_len, r_gen, r_n = 64, 96, 4
+        r_cap = r_len + r_gen + 16
+        r_ecfg = EngineConfig(
+            max_slots=r_n,
+            num_blocks=r_n * ((r_cap + 15) // 16) + 16,
+            block_size=16,
+            max_blocks_per_seq=(r_cap + 15) // 16,
+            prefill_buckets=(r_len,),
+            max_prefills_per_step=r_n,
+            decode_steps_per_iter=4,
+        )
+
+        def r_factory():
+            return InferenceEngine(cfg, params, r_ecfg, eos_id=-1)
+
+        def r_prompt() -> list[int]:
+            return [int(t) for t in
+                    rng.integers(4, cfg.vocab_size - 4, size=r_len)]
+
+        warm_eng = r_factory()
+        warm_eng.generate([r_prompt() for _ in range(r_n)],
+                          SamplingParams(max_tokens=4))
+        del warm_eng
+
+        sup = EngineSupervisor(
+            r_factory,
+            journal=RequestJournal(tempfile.mkdtemp(prefix="bench-wal-"),
+                                   fsync="never"),
+            max_restarts=2,
+            backoff=Backoff(base_s=0.05, cap_s=0.1, jitter=0.0),
+            heartbeat_timeout_s=600.0,   # death-signal path only, no wedge
+            poll_interval_s=0.01,
+        )
+        try:
+            stamps: list[list[float]] = [[] for _ in range(r_n)]
+            handles = [sup.submit(r_prompt(),
+                                  SamplingParams(max_tokens=r_gen))
+                       for _ in range(r_n)]
+
+            def r_consume(i):
+                for _tok in handles[i].stream(timeout=120.0):
+                    stamps[i].append(time.monotonic())
+
+            r_threads = [_th.Thread(target=r_consume, args=(i,), daemon=True)
+                         for i in range(r_n)]
+            for t in r_threads:
+                t.start()
+            # Every request must have streamed progress before the kill so
+            # the replay actually trims delivered tokens.
+            r_deadline = time.monotonic() + 120.0
+            while min((len(s) for s in stamps), default=0) < 4:
+                if time.monotonic() > r_deadline:
+                    raise TimeoutError("no streaming progress before kill")
+                time.sleep(0.002)
+            get_injector().arm("step_loop_crash", rate=1.0, times=1)
+            while sup.state == "serving":
+                if time.monotonic() > r_deadline:
+                    raise TimeoutError("injected crash never detected")
+                time.sleep(0.0005)
+            t_dead = time.monotonic()
+            for t in r_threads:
+                t.join(timeout=120.0)
+            r_res = [h.result(timeout=120.0) for h in handles]
+            assert all(r.finish_reason != "error" for r in r_res)
+            assert all(len(r.token_ids) == r_gen for r in r_res), \
+                "lost or duplicated tokens across the restart"
+            # Any token stamped after death detection is from the rebuilt
+            # engine (the supervisor severs the old loop's observer).
+            first_after = min(t for s in stamps for t in s if t > t_dead)
+            restart_to_token_ms = (first_after - t_dead) * 1e3
+            restart_replayed = sup.replayed_total
+            log(f"warm restart: {restart_to_token_ms:.0f} ms from step-loop "
+                f"death to first replayed token ({sup.restarts} restart, "
+                f"{restart_replayed} requests replayed)")
+        finally:
+            sup.shutdown(grace_s=5.0)
+    except Exception as exc:  # noqa: BLE001 — extras never fail the bench
+        log(f"warm-restart leg skipped: {exc}")
+
     extras = {
         "model": model_name,
         "quant": quant,
@@ -1102,6 +1197,9 @@ def main() -> None:
     if vk_tok_s is not None and vg_tok_s is not None:
         extras["verify_kernel_longctx_tok_s"] = round(vk_tok_s, 1)
         extras["verify_gather_longctx_tok_s"] = round(vg_tok_s, 1)
+    if restart_to_token_ms is not None:
+        extras["warm_restart_to_token_ms"] = round(restart_to_token_ms, 1)
+        extras["warm_restart_replayed"] = restart_replayed
     log(f"total bench time {time.monotonic() - t0:.0f}s")
     print(json.dumps({
         "metric": "p50_ttft_100c_ms",

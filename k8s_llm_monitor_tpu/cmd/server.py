@@ -20,7 +20,34 @@ from __future__ import annotations
 
 import argparse
 import logging
+import signal
 import sys
+import threading
+
+
+def _graceful_shutdown(srv, grace_s: float, log: logging.Logger) -> None:
+    """SIGTERM handover: stop admitting, drain within the grace window,
+    seal the journal, then unblock ``serve_forever`` so the process exits.
+
+    Order matters: readiness flips to 503 first (via the supervisor's
+    TERMINATING state / health DRAINING) so the kube endpoint controller
+    stops routing new traffic while inflight generations finish — the
+    manifest's preStop sleep covers the propagation delay.
+    """
+    sup = srv.engine_supervisor()
+    if sup is not None:
+        drained = sup.shutdown(grace_s=grace_s)
+        log.info("engine supervisor shut down (drained=%s, journal sealed)",
+                 drained)
+    else:
+        svc = srv.engine_service()
+        if svc is not None:
+            svc.drain(timeout=grace_s)
+            svc.stop(timeout=5.0)
+            log.info("engine service drained and stopped")
+    if srv.manager is not None:
+        srv.manager.stop()
+    srv.request_shutdown()
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -95,9 +122,38 @@ def main(argv: list[str] | None = None) -> int:
         log.info(
             "metrics manager started (interval %ds)", config.metrics.collect_interval
         )
+
+    # SIGTERM (kubelet) / SIGINT: flip readiness to 503, drain inflight
+    # generations within the grace window, seal the request journal, exit.
+    # The work runs on a helper thread: httpd.shutdown() deadlocks when
+    # called from the thread running serve_forever, and signal handlers
+    # run exactly there.
+    shutdown_started = threading.Event()
+
+    def _on_signal(signum, frame):  # noqa: ARG001 — signal API
+        if shutdown_started.is_set():
+            log.warning("second signal %d: exiting immediately", signum)
+            raise SystemExit(128 + signum)
+        shutdown_started.set()
+        log.info("signal %d: graceful shutdown (grace %.0fs)",
+                 signum, config.lifecycle.drain_grace_s)
+        threading.Thread(
+            target=_graceful_shutdown,
+            args=(srv, config.lifecycle.drain_grace_s, log),
+            name="graceful-shutdown",
+            daemon=True,
+        ).start()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
     try:
         srv.serve_forever()
     finally:
+        if not shutdown_started.is_set():
+            sup = srv.engine_supervisor()
+            if sup is not None:
+                sup.shutdown(grace_s=0.0)
         if srv.manager is not None:
             srv.manager.stop()
     return 0

@@ -33,7 +33,12 @@ from typing import Any
 
 from k8s_llm_monitor_tpu.monitor.client import Client
 from k8s_llm_monitor_tpu.monitor.cluster import ClusterError
-from k8s_llm_monitor_tpu.monitor.config import AnalysisConfig, LLMConfig
+from k8s_llm_monitor_tpu.monitor.config import (
+    AnalysisConfig,
+    LifecycleConfig,
+    LLMConfig,
+)
+from k8s_llm_monitor_tpu.resilience.errors import OverloadedError
 from k8s_llm_monitor_tpu.monitor.manager import Manager
 from k8s_llm_monitor_tpu.monitor.models import (
     ANALYSIS_TYPES,
@@ -113,12 +118,47 @@ class LocalEngineBackend(LLMBackend):
     # Generations that outlive this are failed (queue + decode worst case).
     GENERATION_TIMEOUT_S = 600.0
 
-    def __init__(self, engine, tokenizer, *, dev_weights: bool = False) -> None:
+    def __init__(self, engine=None, tokenizer=None, *,
+                 dev_weights: bool = False, engine_factory=None,
+                 lifecycle: LifecycleConfig | None = None) -> None:
+        """Two construction modes:
+
+        * ``engine=`` (tests, ad-hoc wiring): the service wraps the given
+          engine directly — a dead step loop is terminal, exactly the PR 2
+          behavior.
+        * ``engine_factory=`` (server boot via ``from_config``): an
+          ``EngineSupervisor`` owns the service, journals admits when
+          ``lifecycle.journal_dir`` is set, and rebuilds + replays on a
+          dead/wedged step loop.
+        """
         from k8s_llm_monitor_tpu.serving.service import EngineService
 
-        self.engine = engine
         self.tokenizer = tokenizer
-        self.service = EngineService(engine)
+        self.supervisor = None
+        self._service = None
+        if engine_factory is not None:
+            from k8s_llm_monitor_tpu.resilience.journal import RequestJournal
+            from k8s_llm_monitor_tpu.resilience.retry import Backoff
+            from k8s_llm_monitor_tpu.serving.supervisor import EngineSupervisor
+
+            lc = lifecycle or LifecycleConfig()
+            journal = None
+            if lc.journal_dir:
+                journal = RequestJournal(
+                    lc.journal_dir,
+                    segment_max_bytes=lc.journal_segment_mb << 20,
+                    fsync=lc.journal_fsync)
+            self.supervisor = EngineSupervisor(
+                engine_factory,
+                journal=journal,
+                max_restarts=lc.max_restarts,
+                heartbeat_timeout_s=lc.heartbeat_timeout_s,
+                backoff=Backoff(base_s=lc.restart_backoff_s,
+                                cap_s=max(lc.restart_backoff_s * 8, 5.0),
+                                jitter=0.0))
+        else:
+            assert engine is not None, "engine or engine_factory required"
+            self._service = EngineService(engine)
         if dev_weights:
             # Random-init weights + byte tokenizer produce byte soup; make
             # that loud in every API response's `model` field instead of
@@ -129,8 +169,25 @@ class LocalEngineBackend(LLMBackend):
                 "llm.tpu.checkpoint configured) — answers are not "
                 "meaningful; set llm.tpu.checkpoint for real diagnosis")
 
+    @property
+    def service(self):
+        """The live EngineService — the supervisor's current one when
+        supervised (it changes across rebuilds), else the pinned one."""
+        if self.supervisor is not None:
+            return self.supervisor.service
+        return self._service
+
+    @property
+    def engine(self):
+        return self.service.engine
+
+    def _submit(self, prompt_ids, sampling):
+        if self.supervisor is not None:
+            return self.supervisor.submit(prompt_ids, sampling)
+        return self.service.submit(prompt_ids, sampling)
+
     @classmethod
-    def from_config(cls, tpu_cfg) -> "LocalEngineBackend":
+    def from_config(cls, tpu_cfg, lifecycle=None) -> "LocalEngineBackend":
         """Build from ``LLMConfig.tpu``: checkpoint weights or random-init
         dev weights for the named preset."""
         import jax
@@ -228,23 +285,31 @@ class LocalEngineBackend(LLMBackend):
             data, seq, model = (int(x) for x in tpu_cfg.mesh_shape.split(","))
             mesh = create_mesh(MeshConfig(data=data, seq=seq, model=model))
 
-        engine = InferenceEngine(
-            cfg,
-            params,
-            EngineConfig(max_slots=tpu_cfg.max_batch,
-                         num_blocks=tpu_cfg.kv_blocks,
-                         spec_k=tpu_cfg.spec_k),
-            tokenizer=tokenizer,
-            mesh=mesh,
-        )
-        return cls(engine, tokenizer, dev_weights=dev_weights)
+        # Factory, not a single engine: the supervisor rebuilds through
+        # this closure after a step-loop death, reusing the (expensive)
+        # params/tokenizer while the KV allocator and slot table start
+        # from baseline by construction.  Weights are jax.Arrays the dead
+        # engine never mutates, so reuse is safe.
+        def engine_factory() -> InferenceEngine:
+            return InferenceEngine(
+                cfg,
+                params,
+                EngineConfig(max_slots=tpu_cfg.max_batch,
+                             num_blocks=tpu_cfg.kv_blocks,
+                             spec_k=tpu_cfg.spec_k),
+                tokenizer=tokenizer,
+                mesh=mesh,
+            )
+
+        return cls(tokenizer=tokenizer, dev_weights=dev_weights,
+                   engine_factory=engine_factory, lifecycle=lifecycle)
 
     def generate(
         self, prompt: str, max_tokens: int = 512, temperature: float = 0.1
     ) -> str:
         from k8s_llm_monitor_tpu.serving.engine import SamplingParams
 
-        handle = self.service.submit(
+        handle = self._submit(
             self.tokenizer.encode(prompt),
             SamplingParams(max_tokens=max_tokens, temperature=temperature),
         )
@@ -263,7 +328,7 @@ class LocalEngineBackend(LLMBackend):
         """
         from k8s_llm_monitor_tpu.serving.engine import SamplingParams
 
-        handle = self.service.submit(
+        handle = self._submit(
             self.tokenizer.encode(prompt),
             SamplingParams(max_tokens=max_tokens, temperature=temperature),
         )
@@ -384,10 +449,11 @@ class OpenAICompatBackend(LLMBackend):
         raise last_err  # type: ignore[misc]
 
 
-def build_backend(cfg: LLMConfig) -> LLMBackend:
+def build_backend(cfg: LLMConfig,
+                  lifecycle: LifecycleConfig | None = None) -> LLMBackend:
     if cfg.provider == "tpu":
         try:
-            return LocalEngineBackend.from_config(cfg.tpu)
+            return LocalEngineBackend.from_config(cfg.tpu, lifecycle=lifecycle)
         except Exception as exc:  # noqa: BLE001 — degrade, never fail boot
             logger.warning(
                 "TPU backend unavailable (%s); falling back to template", exc
@@ -587,6 +653,11 @@ class AnalysisEngine:
                     "evidence": ev,
                 },
             )
+        except OverloadedError:
+            # Admission-control pushback is not an internal failure: let it
+            # propagate to the HTTP layer, which maps it to 429/503 with a
+            # Retry-After hint and queue evidence.
+            raise
         except Exception as exc:  # noqa: BLE001 — API boundary
             logger.exception("query failed")
             return AnalysisResponse(
@@ -645,6 +716,8 @@ class AnalysisEngine:
                 error=str(exc),
                 error_kind="validation",
             )
+        except OverloadedError:
+            raise  # mapped to 429/503 + Retry-After at the HTTP layer
         except Exception as exc:  # noqa: BLE001 — API boundary
             logger.exception("analysis %s failed", request.type)
             return AnalysisResponse(

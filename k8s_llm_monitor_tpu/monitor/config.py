@@ -140,6 +140,29 @@ class AnalysisConfig:
 
 
 @dataclass
+class LifecycleConfig:
+    """Crash-safe serving lifecycle (resilience/journal.py +
+    serving/supervisor.py + cmd/server.py signal handlers).  New; no
+    reference equivalent — the Go reference had no engine to supervise."""
+
+    # Request WAL directory; '' disables journaling (the supervisor still
+    # rebuilds and replays in-process requests).
+    journal_dir: str = ""
+    journal_fsync: str = "interval"  # always | interval | never
+    journal_segment_mb: int = 4
+    # SIGTERM/SIGINT: how long to wait for inflight generations before the
+    # process exits.  Keep below the pod's terminationGracePeriodSeconds
+    # minus the preStop sleep (deployments/monitor-server.yaml).
+    drain_grace_s: float = 20.0
+    # Supervisor: engine rebuilds allowed before giving up, and how stale
+    # the step-loop heartbeat may go (with work pending) before the loop
+    # counts as wedged.
+    max_restarts: int = 3
+    heartbeat_timeout_s: float = 30.0
+    restart_backoff_s: float = 0.5
+
+
+@dataclass
 class LoggingConfig:
     level: str = "info"
     format: str = "json"  # ref config.go default
@@ -155,6 +178,7 @@ class Config:
     monitoring: MonitoringConfig = field(default_factory=MonitoringConfig)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     analysis: AnalysisConfig = field(default_factory=AnalysisConfig)
+    lifecycle: LifecycleConfig = field(default_factory=LifecycleConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
 
 

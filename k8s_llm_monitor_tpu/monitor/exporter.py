@@ -160,6 +160,29 @@ def _resilience_metrics(w: _Writer, engine, service) -> None:
              [("", round(engine.slot_wait_ema_s, 6))])
 
 
+_LIFECYCLE_STATES = ("serving", "rebuilding", "terminating", "stopped",
+                     "failed")
+
+
+def _lifecycle_metrics(w: _Writer, sup) -> None:
+    """Crash-safe lifecycle: supervisor restarts + journal replay (PR 4)."""
+    snap = sup.snapshot()
+    w.metric("lifecycle_state", "gauge",
+             "Serving lifecycle state (1 = current state)",
+             [(f'{{state="{s}"}}', 1 if s == snap["state"] else 0)
+              for s in _LIFECYCLE_STATES])
+    w.metric("engine_restarts_total", "counter",
+             "Engine rebuilds after a dead/wedged step loop",
+             [("", snap["restarts"])])
+    w.metric("journal_replayed_total", "counter",
+             "Requests re-admitted from the journal or in-process tracking "
+             "(rebuild replay + warm start)",
+             [("", snap["replayed_total"])])
+    w.metric("journal_bytes", "gauge",
+             "Request WAL size on disk across live segments",
+             [("", snap["journal_bytes"])])
+
+
 def _kube_breaker_metrics(w: _Writer, breaker) -> None:
     states = ("closed", "open", "half-open")
     state = breaker.state
@@ -235,6 +258,10 @@ def render_prometheus(srv: "MonitorServer") -> str:
     if engine is not None:
         _engine_metrics(w, engine)
         _resilience_metrics(w, engine, service)
+    supervisor = srv.engine_supervisor() if hasattr(
+        srv, "engine_supervisor") else None
+    if supervisor is not None:
+        _lifecycle_metrics(w, supervisor)
     breaker = getattr(getattr(srv.client, "backend", None), "breaker", None)
     if breaker is not None:
         _kube_breaker_metrics(w, breaker)

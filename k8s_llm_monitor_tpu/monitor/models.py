@@ -10,6 +10,7 @@ matching Go ``time.Time`` marshaling.
 from __future__ import annotations
 
 import dataclasses
+import re
 from dataclasses import dataclass, field
 from datetime import datetime, timezone
 from typing import Any
@@ -37,11 +38,21 @@ def rfc3339(ts: datetime | None) -> str:
     return ts.strftime("%Y-%m-%dT%H:%M:%SZ")
 
 
+_FRACTION = re.compile(r"\.(\d+)")
+
+
 def parse_rfc3339(s: str | None) -> datetime | None:
     if not s:
         return None
+    s = s.replace("Z", "+00:00")
+    # RFC3339 allows any fractional-second width (Go's marshaler strips
+    # trailing zeros; k8s emits nanoseconds), but fromisoformat on
+    # Python < 3.11 accepts only exactly 3 or 6 digits — normalize to 6.
+    m = _FRACTION.search(s)
+    if m:
+        s = f"{s[:m.start()]}.{m.group(1)[:6]:0<6}{s[m.end():]}"
     try:
-        return datetime.fromisoformat(s.replace("Z", "+00:00"))
+        return datetime.fromisoformat(s)
     except ValueError:
         return None
 

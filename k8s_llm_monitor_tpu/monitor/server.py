@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import json
 import logging
+import math
 import mimetypes
 import os
 import threading
@@ -39,6 +40,7 @@ from k8s_llm_monitor_tpu.monitor.models import (
     utcnow,
 )
 from k8s_llm_monitor_tpu.monitor.network import NetworkAnalyzer
+from k8s_llm_monitor_tpu.resilience.errors import OverloadedError
 
 logger = logging.getLogger("monitor.server")
 
@@ -84,6 +86,19 @@ class MonitorServer:
         backend = getattr(self.analysis, "backend", None)
         return getattr(backend, "service", None)
 
+    def engine_supervisor(self):
+        """The EngineSupervisor, when the backend runs in supervised mode."""
+        backend = getattr(self.analysis, "backend", None)
+        return getattr(backend, "supervisor", None)
+
+    def request_shutdown(self) -> None:
+        """Unblock ``serve_forever`` from another thread (signal handlers
+        must not call ``httpd.shutdown`` from the serving thread itself —
+        it would deadlock)."""
+        httpd = self._httpd
+        if httpd is not None:
+            httpd.shutdown()
+
     def health_snapshot(self) -> dict[str, Any]:
         """Aggregate live health across the wired components — the body of
         ``/health``.  Dev mode (no engine) is healthy by definition: there
@@ -114,6 +129,16 @@ class MonitorServer:
                 "deadline_expired": engine.deadline_expired,
                 "requeues": engine.requeues,
             }
+        sup = self.engine_supervisor()
+        if sup is not None:
+            lc = sup.snapshot()
+            snap["lifecycle"] = lc
+            # A terminating/rebuilding/failed supervisor must stop traffic
+            # even if the engine health state hasn't caught up yet.
+            if lc["state"] != "serving":
+                snap["ready"] = False
+                if not snap["reason"]:
+                    snap["reason"] = f"lifecycle state {lc['state']}"
         breaker = getattr(getattr(self.client, "backend", None),
                           "breaker", None)
         if breaker is not None:
@@ -193,16 +218,37 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
         # -- plumbing ---------------------------------------------------------
 
         def _send_json(
-            self, payload: Any, status: int = 200, cors: bool = False
+            self, payload: Any, status: int = 200, cors: bool = False,
+            headers: dict[str, str] | None = None,
         ) -> None:
             body = json.dumps(to_jsonable(payload)).encode()
             self.send_response(status)
             self.send_header("Content-Type", "application/json")
             if cors:
                 self.send_header("Access-Control-Allow-Origin", "*")
+            for name, value in (headers or {}).items():
+                self.send_header(name, value)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        def _send_overloaded(self, exc: OverloadedError) -> None:
+            retry_after = max(1, math.ceil(exc.retry_after_s))
+            self._send_json(
+                {
+                    "status": "error",
+                    "error": str(exc),
+                    "error_kind": "overloaded",
+                    "reason": exc.reason,
+                    "retriable": exc.retriable,
+                    "retry_after_s": exc.retry_after_s,
+                    "queue_depth": exc.queue_depth,
+                    "queue_tokens": exc.queue_tokens,
+                    "timestamp": _now(),
+                },
+                status=429 if exc.retriable else 503,
+                headers={"Retry-After": str(retry_after)},
+            )
 
         def _send_error_text(self, msg: str, status: int) -> None:
             # mirrors Go http.Error: plain text + newline
@@ -258,6 +304,16 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
                 return self._send_error_text("404 page not found", 404)
             except BrokenPipeError:
                 pass
+            except OverloadedError as exc:
+                # Admission-control pushback from the engine/supervisor:
+                # 429 when retrying this replica can work (shed, rebuild in
+                # progress), 503 when it cannot (draining, failed).  Both
+                # carry a Retry-After derived from the shed/restart backoff
+                # and the queue evidence a client-side balancer needs.
+                try:
+                    self._send_overloaded(exc)
+                except Exception:  # noqa: BLE001
+                    pass
             except Exception as exc:  # noqa: BLE001 — server must not die
                 logger.exception("handler error for %s %s", method, path)
                 try:
@@ -458,6 +514,8 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
             of the answer is still decoding."""
             try:
                 request_id, model, chunks = srv.analysis.query_stream(question)
+            except OverloadedError as exc:  # headers not sent yet: 429/503
+                return self._send_overloaded(exc)
             except Exception as exc:  # noqa: BLE001 — before headers: 500
                 return self._send_error_text(f"query failed: {exc}", 500)
             self.send_response(200)
@@ -780,7 +838,7 @@ def build_server(
             client = None
     if client is not None and config.metrics.enabled:
         manager = Manager(client, config.metrics, uav_fetcher=uav_fetcher)
-    llm_backend = build_backend(config.llm)
+    llm_backend = build_backend(config.llm, lifecycle=config.lifecycle)
     detector = None
     if config.analysis.embedding_model:
         try:

@@ -11,17 +11,27 @@ point, and one observable surface:
   * ``retry``   — jittered exponential :class:`Backoff` with a retry budget
     and the :class:`CircuitBreaker` used by the kube REST backend;
   * ``health``  — :class:`HealthMonitor`, the HEALTHY → DEGRADED →
-    DRAINING/UNHEALTHY state machine behind ``/health`` and ``/readyz``.
+    DRAINING/UNHEALTHY state machine behind ``/health`` and ``/readyz``;
+  * ``journal`` — :class:`RequestJournal`, the append-only request WAL
+    behind the crash-safe lifecycle (serving/supervisor.py replays it);
+  * ``errors``  — :class:`OverloadedError`, the admission-refusal error
+    the HTTP layer maps to 429/503 + Retry-After.
 
 Everything here is stdlib-only and CPU-deterministic (seeded RNGs,
 injectable clocks) so chaos tests reproduce bit-identically in CI.
 """
 
+from k8s_llm_monitor_tpu.resilience.errors import OverloadedError
 from k8s_llm_monitor_tpu.resilience.faults import (
     FAULT_POINTS,
     FaultError,
     FaultInjector,
     get_injector,
+)
+from k8s_llm_monitor_tpu.resilience.journal import (
+    JournaledRequest,
+    RequestJournal,
+    scan_journal,
 )
 from k8s_llm_monitor_tpu.resilience.health import (
     DEGRADED,
@@ -41,6 +51,10 @@ __all__ = [
     "FaultError",
     "FaultInjector",
     "get_injector",
+    "OverloadedError",
+    "JournaledRequest",
+    "RequestJournal",
+    "scan_journal",
     "Backoff",
     "CircuitBreaker",
     "CircuitOpen",

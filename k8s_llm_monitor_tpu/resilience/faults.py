@@ -37,6 +37,8 @@ FAULT_POINTS: frozenset[str] = frozenset({
     "slow_host_callback",   # reconcile-side host work sleeps delay_s
     # serving/kv_cache.py — allocator
     "alloc_exhaustion",     # alloc/extend raise OutOfBlocks despite free pages
+    # serving/service.py — step loop
+    "step_loop_crash",      # step loop raises mid-iteration (supervisor food)
     # monitor/kube_rest.py — apiserver client
     "kube_http_5xx",        # _request sees a synthetic 503
     "kube_http_timeout",    # _request sees a synthetic socket timeout

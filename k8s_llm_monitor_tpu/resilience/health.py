@@ -99,6 +99,14 @@ class HealthMonitor:
         with self._lock:
             self._dead_reason = reason
 
+    def clear_dead(self) -> None:
+        """The supervisor rebuilt the engine: un-pin UNHEALTHY so the
+        replica can re-enter rotation (recent-window evidence still holds
+        the state at DEGRADED until a clean window passes)."""
+        with self._lock:
+            self._dead_reason = None
+            self._consecutive_failures = 0
+
     # -- state ----------------------------------------------------------
 
     def _prune(self, now: float) -> None:

@@ -10,11 +10,13 @@ from k8s_llm_monitor_tpu.serving.engine import (
     SamplingParams,
 )
 from k8s_llm_monitor_tpu.serving.service import EngineService, RequestHandle
+from k8s_llm_monitor_tpu.serving.supervisor import EngineSupervisor
 
 __all__ = [
     "BlockAllocator",
     "EngineConfig",
     "EngineService",
+    "EngineSupervisor",
     "GenerationRequest",
     "GenerationResult",
     "InferenceEngine",

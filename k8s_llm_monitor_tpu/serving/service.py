@@ -16,14 +16,19 @@ device (streaming seam for SSE in monitor/server.py) and a final
 from __future__ import annotations
 
 import atexit
+import dataclasses
 import itertools
+import logging
 import queue
 import threading
 import time
-from typing import Iterator, Optional
+from typing import Callable, Iterator, Optional
 
-from k8s_llm_monitor_tpu.devtools.lockcheck import make_lock
+from k8s_llm_monitor_tpu.devtools.lockcheck import guarded_by, make_lock
+from k8s_llm_monitor_tpu.resilience.errors import OverloadedError
+from k8s_llm_monitor_tpu.resilience.faults import get_injector
 from k8s_llm_monitor_tpu.resilience.health import HealthMonitor
+from k8s_llm_monitor_tpu.resilience.retry import Backoff
 from k8s_llm_monitor_tpu.serving.engine import (
     GenerationRequest,
     GenerationResult,
@@ -31,22 +36,13 @@ from k8s_llm_monitor_tpu.serving.engine import (
     SamplingParams,
 )
 
+__all__ = [
+    "EngineService",
+    "OverloadedError",  # re-export: defined in resilience/errors.py
+    "RequestHandle",
+]
 
-class OverloadedError(Exception):
-    """Admission refused by load shedding (or drain).  Retriable: the
-    caller should back off and retry (HTTP layer maps this to 429/503 with
-    Retry-After).  Carries the backlog evidence so clients and logs see
-    *why* they were shed."""
-
-    def __init__(self, reason: str, queue_depth: int = 0,
-                 queue_tokens: int = 0, retriable: bool = True):
-        super().__init__(
-            f"overloaded: {reason} "
-            f"(queue_depth={queue_depth}, queue_tokens={queue_tokens})")
-        self.reason = reason
-        self.queue_depth = queue_depth
-        self.queue_tokens = queue_tokens
-        self.retriable = retriable
+logger = logging.getLogger("serving.service")
 
 
 class RequestHandle:
@@ -64,6 +60,10 @@ class RequestHandle:
         self._done = threading.Event()
         self._result: Optional[GenerationResult] = None
         self._cancel_fn = cancel_fn
+        # Tokens delivered by a previous engine incarnation (supervisor
+        # replay): already streamed to the caller, prepended to the final
+        # result so token_ids stays the complete output.
+        self._replay_prefix: list[int] = []
 
     def cancel(self) -> None:
         """Ask the engine to stop generating (client went away).  The final
@@ -78,6 +78,10 @@ class RequestHandle:
             if t != self._eos_id:
                 self._tokens.put(t)
         if result is not None:
+            if self._replay_prefix:
+                result = dataclasses.replace(
+                    result,
+                    token_ids=self._replay_prefix + list(result.token_ids))
             self._result = result
             self._done.set()
             self._tokens.put(None)  # stream sentinel
@@ -112,13 +116,23 @@ class RequestHandle:
         return self._done.is_set()
 
 
+@guarded_by("_handles_lock", "_draining", "_dead", "shed_count",
+            "_shed_streak")
 class EngineService:
     """Background step-loop over an ``InferenceEngine`` with thread-safe
     submission.  The loop thread is the only toucher of engine state; callers
-    talk through a submission queue and per-request handles."""
+    talk through a submission queue and per-request handles.
+
+    Lifecycle hooks (serving/supervisor.py): ``on_death`` is called instead
+    of failing the handles when the step loop dies, so a supervisor can
+    rebuild the engine and replay the survivors; ``observer`` sees every
+    (request_id, toks, result) delivery *before* the handle does, which is
+    where the request journal checkpoints progress.
+    """
 
     def __init__(self, engine: InferenceEngine,
-                 health: HealthMonitor | None = None):
+                 health: HealthMonitor | None = None,
+                 on_death: Callable[[str], None] | None = None):
         self.engine = engine
         engine.token_sink = self._sink
         # One health monitor per service: the engine reports dispatch
@@ -126,17 +140,29 @@ class EngineService:
         # and /health + /readyz read it.
         self.health = health or HealthMonitor()
         engine.health = self.health
+        self.on_death = on_death
+        self.observer: Callable[
+            [str, list[int], Optional[GenerationResult]], None] | None = None
+        self._faults = get_injector()
         self._submissions: "queue.Queue[GenerationRequest]" = queue.Queue()
         self._cancels: "queue.Queue[str]" = queue.Queue()
         self._cancelled: set[str] = set()
         self._handles: dict[str, RequestHandle] = {}
-        self._handles_lock = make_lock("service.handles")
         self._ids = itertools.count()
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._draining = False
         self.shed_count = 0
+        self._shed_streak = 0  # consecutive sheds -> Retry-After hint
+        self._shed_backoff = Backoff(base_s=1.0, cap_s=8.0, jitter=0.0)
         self._dead: str | None = None  # set when the step loop dies
+        # Step-loop liveness beat: refreshed every iteration; a stale beat
+        # with work pending means the loop is wedged inside a dispatch
+        # (supervisor's rebuild trigger alongside _dead).
+        self.last_heartbeat = time.monotonic()
+        # Created last: lockcheck's guarded_by treats writes before the
+        # lock exists as construction, not races.
+        self._handles_lock = make_lock("service.handles")
         self._thread = threading.Thread(
             target=self._run, name="engine-service", daemon=True)
         self._thread.start()
@@ -149,34 +175,64 @@ class EngineService:
 
     # -- submission -----------------------------------------------------
 
+    def _record_shed(self) -> float:
+        """Bump shed counters; returns a Retry-After hint that backs off
+        with consecutive sheds (resets on the next successful admit)."""
+        with self._handles_lock:
+            self.shed_count += 1
+            self._shed_streak += 1
+            streak = self._shed_streak
+        self.health.record_shed()
+        return self._shed_backoff.delay(min(streak - 1, 4))
+
     def submit(
         self,
         prompt_ids: list[int],
         sampling: SamplingParams | None = None,
         request_id: str | None = None,
         deadline_s: float = 0.0,
+        force: bool = False,
+        handle: RequestHandle | None = None,
     ) -> RequestHandle:
-        if self._dead is not None:
-            raise RuntimeError(f"engine service is dead: {self._dead}")
-        if self._draining or self._stop.is_set():
-            # Not retriable *here* — this replica is going away; the
-            # client should retry against another replica.
-            self.shed_count += 1
-            self.health.record_shed()
-            raise OverloadedError("draining", retriable=False)
-        reason = self.engine.should_shed()
-        if reason:
-            self.shed_count += 1
-            self.health.record_shed()
-            raise OverloadedError(
-                reason,
-                queue_depth=self.engine.queue_depth,
-                queue_tokens=self.engine.queue_tokens)
+        """Admit a generation request.
+
+        ``force`` bypasses drain/shed checks (supervisor replay: the
+        request was already accepted once and must not be refused on its
+        way back in).  ``handle`` re-installs an existing RequestHandle
+        under the same request id so a replayed request keeps streaming to
+        the original caller with no token gap.
+        """
+        with self._handles_lock:
+            dead = self._dead
+            draining = self._draining
+        if dead is not None:
+            raise RuntimeError(f"engine service is dead: {dead}")
+        if not force:
+            if draining or self._stop.is_set():
+                # Not retriable *here* — this replica is going away; the
+                # client should retry against another replica.
+                hint = self._record_shed()
+                raise OverloadedError("draining", retriable=False,
+                                      retry_after_s=hint)
+            reason = self.engine.should_shed()
+            if reason:
+                hint = self._record_shed()
+                raise OverloadedError(
+                    reason,
+                    queue_depth=self.engine.queue_depth,
+                    queue_tokens=self.engine.queue_tokens,
+                    retry_after_s=hint)
         self.health.record_admit()
+        with self._handles_lock:
+            self._shed_streak = 0
         if request_id is None:
             request_id = f"svc-{next(self._ids)}"
-        handle = RequestHandle(request_id, self.engine.eos_id,
-                               cancel_fn=self._request_cancel)
+        if handle is None:
+            handle = RequestHandle(request_id, self.engine.eos_id,
+                                   cancel_fn=self._request_cancel)
+        else:
+            handle._eos_id = self.engine.eos_id
+            handle._cancel_fn = self._request_cancel
         with self._handles_lock:
             self._handles[request_id] = handle
         self._submissions.put(GenerationRequest(
@@ -214,7 +270,8 @@ class EngineService:
         """Stop admitting new work (submit() sheds with ``draining``) and
         wait for queued + inflight requests to finish and their streams to
         flush.  Returns True when fully drained within ``timeout``."""
-        self._draining = True
+        with self._handles_lock:
+            self._draining = True
         self.health.set_draining(True)
         deadline = time.monotonic() + timeout
         while time.monotonic() < deadline:
@@ -230,25 +287,38 @@ class EngineService:
         """Stop the step loop.  ``drain_s > 0`` first drains gracefully
         (finish inflight, flush streams); any handle still unresolved when
         the loop exits is failed so no client blocks forever."""
-        if drain_s > 0 and self._dead is None:
+        with self._handles_lock:
+            self._draining = True  # no admission races the shutdown
+            dead = self._dead
+        if drain_s > 0 and dead is None:
             self.drain(timeout=drain_s)
         self._stop.set()
         self._wake.set()
         self._thread.join(timeout=timeout)
         atexit.unregister(self.stop)
-        if self._dead is None:
+        with self._handles_lock:
+            dead = self._dead
+        if dead is None:
             self._fail_all("service stopped")
 
     # -- loop -----------------------------------------------------------
 
     def _fail_handle(self, request_id: str, msg: str) -> None:
+        result = GenerationResult(
+            request_id=request_id, token_ids=[], finish_reason="error",
+            ttft_s=0.0, latency_s=0.0, error=msg,
+        )
+        # Terminal outcome: the observer (journal) must tombstone it so a
+        # restart doesn't resurrect an invalid/cancelled request.
+        if self.observer is not None:
+            try:
+                self.observer(request_id, [], result)
+            except Exception:  # noqa: BLE001 — observer must not kill the loop
+                logger.exception("observer failed for %s", request_id)
         with self._handles_lock:
             handle = self._handles.pop(request_id, None)
         if handle is not None:
-            handle._push([], GenerationResult(
-                request_id=request_id, token_ids=[], finish_reason="error",
-                ttft_s=0.0, latency_s=0.0, error=msg,
-            ))
+            handle._push([], result)
 
     def _drain_submissions(self) -> None:
         # Cancels first: a cancel aimed at a request still sitting in the
@@ -281,20 +351,35 @@ class EngineService:
             self._cancelled.discard(rid)
 
     def _run(self) -> None:
-        while not self._stop.is_set():
-            self._drain_submissions()
-            if self.engine.has_work:
-                try:
+        try:
+            while not self._stop.is_set():
+                self.last_heartbeat = time.monotonic()
+                self._faults.maybe_raise("step_loop_crash")
+                self._drain_submissions()
+                if self.engine.has_work:
                     self.engine.step()
-                except Exception as exc:  # engine is corrupt — fail everything
-                    self._dead = f"engine step failed: {exc!r}"
-                    self.health.set_dead(self._dead)
-                    self._fail_all(self._dead)
-                    raise
+                else:
+                    # Idle: sleep until a submission arrives.
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
+        except Exception as exc:  # engine is corrupt — fail or hand off
+            msg = f"engine step failed: {exc!r}"
+            with self._handles_lock:
+                self._dead = msg
+            self.health.set_dead(msg)
+            if self.on_death is not None:
+                # A supervisor owns recovery: keep the handles alive so
+                # their requests can be replayed on the rebuilt engine.
+                # Exit quietly — the exception IS handled (by the rebuild),
+                # so don't trip thread-excepthook noise.
+                try:
+                    self.on_death(msg)
+                except Exception:  # noqa: BLE001 — dying thread, best effort
+                    logger.exception("on_death callback failed")
+                logger.warning("step loop dead, awaiting supervisor: %s", msg)
             else:
-                # Idle: sleep until a submission arrives.
-                self._wake.wait(timeout=0.05)
-                self._wake.clear()
+                self._fail_all(msg)
+                raise
 
     def _fail_all(self, msg: str) -> None:
         # Drain submissions that raced the death of the loop so their
@@ -315,6 +400,16 @@ class EngineService:
 
     def _sink(self, request_id: str, toks: list[int],
               result: Optional[GenerationResult]) -> None:
+        # Observer first, and outside the handles lock: the journal must
+        # checkpoint tokens BEFORE they reach the caller (a token streamed
+        # but never journaled would be re-generated on replay — a
+        # duplicate), and the observer takes the supervisor's lock (lock
+        # order: supervisor -> service, never the reverse).
+        if self.observer is not None:
+            try:
+                self.observer(request_id, toks, result)
+            except Exception:  # noqa: BLE001 — observer must not kill the loop
+                logger.exception("observer failed for %s", request_id)
         with self._handles_lock:
             handle = self._handles.get(request_id)
             if result is not None:
@@ -324,3 +419,12 @@ class EngineService:
         if result is not None:
             # Results are delivered through handles; drop the engine's copy.
             self.engine.poll(request_id)
+
+    def detach_handles(self) -> dict[str, RequestHandle]:
+        """Hand every live handle to the supervisor (rebuild path): the
+        dying service must not fail them — they will be re-attached to the
+        replacement service via ``submit(handle=...)``."""
+        with self._handles_lock:
+            handles = dict(self._handles)
+            self._handles.clear()
+        return handles

@@ -108,6 +108,27 @@ def test_rfc3339_format():
     assert rfc3339(ts) == "2026-07-29T12:00:05Z"
 
 
+def test_rfc3339_round_trip_any_fraction_width():
+    # rfc3339 strips trailing fraction zeros (Go marshaling), so the wire
+    # carries 1-6 digit fractions; fromisoformat on Python < 3.11 only
+    # accepts 3 or 6.  A parse failure here is not cosmetic: the scheduler
+    # skips the staleness check for CRs whose last_update doesn't parse.
+    from datetime import datetime, timezone
+
+    from k8s_llm_monitor_tpu.monitor.models import parse_rfc3339
+
+    base = datetime(2026, 7, 29, 12, 0, 5, tzinfo=timezone.utc)
+    for us in (0, 1, 100, 1000, 400000, 447710, 447711, 999999):
+        ts = base.replace(microsecond=us)
+        assert parse_rfc3339(rfc3339(ts)) == ts, us
+    # k8s-style nanosecond fractions truncate instead of failing
+    assert parse_rfc3339("2026-07-29T12:00:05.123456789Z") == base.replace(
+        microsecond=123456
+    )
+    assert parse_rfc3339("not-a-timestamp") is None
+    assert parse_rfc3339("") is None
+
+
 def test_quantity_parsing():
     assert parse_cpu_millis("250m") == 250
     assert parse_cpu_millis("2") == 2000
