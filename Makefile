@@ -6,9 +6,9 @@ TEST_ENV = env PYTHONPATH= JAX_PLATFORMS=cpu
 SHELL := /bin/bash    # tier1 uses pipefail/PIPESTATUS
 
 .PHONY: run run-agent run-scheduler demo test test-fast tier1 chaos \
-        chaos-lifecycle bench bench-decode dryrun smoke preflight \
-        deploy-agent docker docker-agent docker-scheduler lint lint-trace \
-        clean
+        chaos-lifecycle chaos-fleet bench bench-decode bench-fleet dryrun \
+        smoke preflight deploy-agent docker docker-agent docker-scheduler \
+        lint lint-trace clean
 
 run:
 	$(PY) -m k8s_llm_monitor_tpu.cmd.server --cluster fake --port 8081
@@ -51,11 +51,21 @@ chaos-lifecycle:
 	$(TEST_ENV) K8SLLM_LOCKCHECK=1 K8SLLM_JOURNAL_FSYNC=never \
 	  $(PY) -m pytest tests/test_lifecycle.py -q -p no:cacheprovider
 
+# Fleet tier acceptance: router policies, hedging, 32-stream mid-kill
+# failover (docs/fleet.md), with lock discipline checked.
+chaos-fleet:
+	$(TEST_ENV) K8SLLM_LOCKCHECK=1 \
+	  $(PY) -m pytest tests/test_fleet.py -q -p no:cacheprovider
+
 bench:
 	$(PY) bench.py
 
 bench-decode:       # fused-vs-fallback decode microbench + phase attribution
 	env BENCH_CONCURRENCY=8 BENCH_MAX_TOKENS=16 $(PY) bench.py
+
+bench-fleet:        # CPU fleet smoke: 1-vs-2 replicas, hedged tail latency
+	$(TEST_ENV) BENCH_FLEET_ONLY=1 BENCH_MODEL=tiny \
+	  $(PY) bench.py | tee fleet-bench.json
 
 smoke:              # boot server + 20-check live API suite
 	$(TEST_ENV) bash scripts/smoke.sh

@@ -89,6 +89,101 @@ def weight_accounting(params, tied: bool) -> tuple[int, int]:
     return elems, stream_bytes
 
 
+def fleet_leg(cfg, params) -> dict:
+    """Fleet tier (fleet/router.py): 1 vs 2 in-process replicas behind the
+    router — aggregate throughput and the per-request completion-latency
+    tail, then the same 2-replica burst with hedged dispatch on.  The
+    replicas share ``params`` (no extra weight copies); each gets its own
+    small KV pool."""
+    import numpy as np
+
+    from k8s_llm_monitor_tpu.fleet import (
+        FleetRouter,
+        HedgeConfig,
+        LocalReplica,
+        ReplicaRegistry,
+    )
+    from k8s_llm_monitor_tpu.serving.engine import (
+        EngineConfig,
+        InferenceEngine,
+        SamplingParams,
+    )
+    from k8s_llm_monitor_tpu.serving.service import EngineService
+
+    rng = np.random.default_rng(7)
+    f_len = int(os.environ.get("BENCH_FLEET_PROMPT_LEN", "64"))
+    f_gen = int(os.environ.get("BENCH_FLEET_MAX_TOKENS", "32"))
+    f_n = int(os.environ.get("BENCH_FLEET_CONCURRENCY", "16"))
+    f_cap = f_len + f_gen + 16
+    f_ecfg = EngineConfig(
+        max_slots=8,
+        num_blocks=8 * ((f_cap + 15) // 16) + 16,
+        block_size=16,
+        max_blocks_per_seq=(f_cap + 15) // 16,
+        prefill_buckets=(f_len,),
+        max_prefills_per_step=8,
+        decode_steps_per_iter=4,
+    )
+
+    def f_prompt() -> list[int]:
+        return [int(t) for t in
+                rng.integers(4, cfg.vocab_size - 4, size=f_len)]
+
+    def fleet_run(n_reps: int, hedge=None):
+        reps = [
+            LocalReplica(
+                f"bench-r{i}",
+                service=EngineService(
+                    InferenceEngine(cfg, params, f_ecfg, eos_id=-1)))
+            for i in range(n_reps)
+        ]
+        reg = ReplicaRegistry()
+        for r in reps:
+            reg.add(r)
+        reg.refresh()
+        router = FleetRouter(reg, policy="affinity", hedge=hedge)
+        try:
+            t_start = time.monotonic()
+            flights = [(time.monotonic(),
+                        router.submit(f_prompt(),
+                                      SamplingParams(max_tokens=f_gen)))
+                       for _ in range(f_n)]
+            lat = []
+            for t_sub, h in flights:
+                res = h.result(timeout=600.0)
+                assert res.finish_reason == "length", res.error
+                lat.append(time.monotonic() - t_sub)
+            wall = time.monotonic() - t_start
+        finally:
+            for r in reps:
+                r.close()
+        p99_ms = float(np.percentile(np.array(sorted(lat)), 99)) * 1e3
+        return f_n * f_gen / wall, p99_ms, router.counters()
+
+    one_tok_s, _, _ = fleet_run(1)
+    log(f"fleet: 1 replica {one_tok_s:.1f} tok/s "
+        f"({f_n} concurrent, gen {f_gen})")
+    two_tok_s, unhedged_p99_ms, c2 = fleet_run(2)
+    log(f"fleet: 2 replicas {two_tok_s:.1f} tok/s, unhedged p99 "
+        f"completion {unhedged_p99_ms:.0f} ms "
+        f"(affinity hits {c2['affinity_hits']}, "
+        f"spills {c2['affinity_spills']})")
+    _, hedged_p99_ms, ch = fleet_run(2, hedge=HedgeConfig(enabled=True))
+    log(f"fleet: 2 replicas hedged p99 completion {hedged_p99_ms:.0f} ms "
+        f"({ch['hedges_fired']} hedges fired, {ch['hedges_won']} won)")
+    return {
+        "fleet_1replica_tok_s": round(one_tok_s, 1),
+        "fleet_2replica_tok_s": round(two_tok_s, 1),
+        "fleet_unhedged_p99_completion_ms": round(unhedged_p99_ms, 1),
+        "fleet_hedged_p99_completion_ms": round(hedged_p99_ms, 1),
+        "fleet_hedges_fired": ch["hedges_fired"],
+        "fleet_hedges_won": ch["hedges_won"],
+        "fleet_affinity_hits": c2["affinity_hits"],
+        "fleet_affinity_spills": c2["affinity_spills"],
+        "fleet_concurrency": f_n,
+    }
+
+
 def main() -> None:
     t0 = time.monotonic()
     cache_was_warm = CACHE_DIR.is_dir() and any(CACHE_DIR.iterdir())
@@ -133,6 +228,19 @@ def main() -> None:
     weight_bytes = qz.param_bytes(params)
     log(f"weights: {weight_elems/1e9:.2f}B matmul params, "
         f"{weight_bytes/2**30:.2f} GiB on device")
+
+    if os.environ.get("BENCH_FLEET_ONLY", "0") == "1":
+        # Fast CPU-only fleet smoke for `make bench-fleet`: skips the ~12
+        # main legs and runs just the 1-vs-2-replica router comparison.
+        stats = fleet_leg(cfg, params)
+        print(json.dumps({
+            "metric": "fleet_2replica_tok_s",
+            "value": stats.get("fleet_2replica_tok_s", 0.0),
+            "unit": "tok/s",
+            "extras": {"model": model_name, "platform": dev.platform,
+                       **stats},
+        }))
+        return
 
     # Prompt bucket hugs the prompt length (rounded to the 64-lane sublane
     # multiple; 192 itself is 1.5 * 128 and MXU-friendly): minimal padding
@@ -1097,6 +1205,13 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001 — extras never fail the bench
         log(f"warm-restart leg skipped: {exc}")
 
+    fleet_stats: dict = {}
+    try:
+        if os.environ.get("BENCH_FLEET", "1") == "1":
+            fleet_stats = fleet_leg(cfg, params)
+    except Exception as exc:  # noqa: BLE001 — extras never fail the bench
+        log(f"fleet leg skipped: {exc}")
+
     extras = {
         "model": model_name,
         "quant": quant,
@@ -1200,6 +1315,7 @@ def main() -> None:
     if restart_to_token_ms is not None:
         extras["warm_restart_to_token_ms"] = round(restart_to_token_ms, 1)
         extras["warm_restart_replayed"] = restart_replayed
+    extras.update(fleet_stats)
     log(f"total bench time {time.monotonic() - t0:.0f}s")
     print(json.dumps({
         "metric": "p50_ttft_100c_ms",

@@ -45,6 +45,9 @@ def _graceful_shutdown(srv, grace_s: float, log: logging.Logger) -> None:
             svc.drain(timeout=grace_s)
             svc.stop(timeout=5.0)
             log.info("engine service drained and stopped")
+    if srv.fleet_router() is not None:
+        srv.analysis.close()  # stop probes, close replica adapters
+        log.info("fleet router closed")
     if srv.manager is not None:
         srv.manager.stop()
     srv.request_shutdown()
@@ -67,6 +70,19 @@ def main(argv: list[str] | None = None) -> int:
         default="",
         help="override llm.provider (tpu | openai | template)",
     )
+    parser.add_argument(
+        "--role",
+        choices=("replica", "router"),
+        default="replica",
+        help="replica: serve a local engine (default); router: front the "
+             "fleet.replicas URLs with policy routing + failover",
+    )
+    parser.add_argument(
+        "--replicas",
+        default="",
+        help="router role: comma-separated replica base URLs "
+             "(overrides fleet.replicas / FLEET_REPLICAS)",
+    )
     args = parser.parse_args(argv)
 
     from k8s_llm_monitor_tpu.monitor.config import load_config
@@ -84,6 +100,39 @@ def main(argv: list[str] | None = None) -> int:
         config.server.port = args.port
     if args.llm:
         config.llm.provider = args.llm
+    if args.replicas:
+        config.fleet.replicas = [
+            u.strip() for u in args.replicas.split(",") if u.strip()]
+
+    if args.role == "router":
+        # Router role: no local engine, no cluster client — just the fleet
+        # behind the same /api/v1/query + /api/v1/analyze API.
+        from k8s_llm_monitor_tpu.fleet.frontend import build_router_server
+
+        srv = build_router_server(config)
+        shutdown_started = threading.Event()
+
+        def _on_router_signal(signum, frame):  # noqa: ARG001 — signal API
+            if shutdown_started.is_set():
+                raise SystemExit(128 + signum)
+            shutdown_started.set()
+            log.info("signal %d: router shutting down", signum)
+
+            def _stop() -> None:
+                srv.analysis.close()
+                srv.request_shutdown()
+
+            threading.Thread(target=_stop, name="graceful-shutdown",
+                             daemon=True).start()
+
+        signal.signal(signal.SIGTERM, _on_router_signal)
+        signal.signal(signal.SIGINT, _on_router_signal)
+        try:
+            srv.serve_forever()
+        finally:
+            if not shutdown_started.is_set():
+                srv.analysis.close()
+        return 0
 
     if config.llm.provider == "tpu" and config.llm.tpu.compile_cache_dir:
         # Persistent XLA compilation cache BEFORE any jit runs: a warm

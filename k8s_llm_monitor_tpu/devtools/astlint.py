@@ -370,9 +370,53 @@ class FaultPointRule(Rule):
                         f"makes the hook silently never fire")
 
 
+class RawLockRule(Rule):
+    """Locks must come from ``devtools.lockcheck.make_lock``.
+
+    A raw ``threading.Lock()`` bypasses the lockcheck instrumentation:
+    ``K8SLLM_LOCKCHECK=1`` chaos runs can't see its hold times or
+    ``@guarded_by`` violations, so a subsystem built on raw locks gets no
+    race coverage at all.  ``lockcheck.py`` itself (the factory) is the
+    one legitimate construction site.
+    """
+
+    name = "raw-lock"
+    description = "raw threading.Lock() outside devtools.lockcheck"
+
+    _LOCK_CALLS = {"threading.Lock", "threading.RLock"}
+
+    @staticmethod
+    def _threading_imports(tree: ast.Module) -> set[str]:
+        """Local names bound to threading.Lock/RLock via from-imports."""
+        names: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "threading":
+                for alias in node.names:
+                    if alias.name in ("Lock", "RLock"):
+                        names.add(alias.asname or alias.name)
+        return names
+
+    def check(self, tree: ast.Module, path: str) -> Iterator[Finding]:
+        if path.replace("\\", "/").endswith("devtools/lockcheck.py"):
+            return
+        bare = self._threading_imports(tree)
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = dotted_name(node.func)
+            # ``bare`` holds only names bound from threading.Lock/RLock
+            # (including asnames), so membership alone marks a lock call.
+            if dn in self._LOCK_CALLS or dn in bare:
+                yield self.finding(
+                    path, node,
+                    f"raw {dn}() bypasses lockcheck instrumentation; use "
+                    f"devtools.lockcheck.make_lock(name) so "
+                    f"K8SLLM_LOCKCHECK=1 runs can audit it")
+
+
 def default_rules() -> list[Rule]:
     return [JitHostReadRule(), LockBlockingCallRule(), BareExceptRule(),
-            MutableDefaultRule(), FaultPointRule()]
+            MutableDefaultRule(), FaultPointRule(), RawLockRule()]
 
 
 ALL_RULE_NAMES = tuple(r.name for r in default_rules())

@@ -1,0 +1,36 @@
+"""Fleet tier: route queries across N engine replicas.
+
+The single-replica stack (PRs 1–4) made one engine fast, crash-safe, and
+observable; this package scales it *out*: a ``ReplicaRegistry`` tracks
+replica health and load, replica adapters put in-process engines and remote
+HTTP replicas behind one interface, and the ``FleetRouter`` dispatches with
+pluggable policies (least-loaded, prefix-affinity rendezvous hashing),
+per-replica circuit breakers, hedged dispatch, and mid-stream failover with
+the supervisor's idempotent-replay contract.  See docs/fleet.md.
+"""
+
+from k8s_llm_monitor_tpu.fleet.registry import (Candidate, ReplicaRegistry,
+                                                ReplicaStats)
+from k8s_llm_monitor_tpu.fleet.replica import (HTTPReplica, LocalReplica,
+                                               Replica, ReplicaUnavailable)
+from k8s_llm_monitor_tpu.fleet.router import (POLICIES, FleetRouter,
+                                              HedgeConfig, LeastLoadedPolicy,
+                                              PrefixAffinityPolicy,
+                                              RoundRobinPolicy, RoutingPolicy)
+
+__all__ = [
+    "Candidate",
+    "ReplicaRegistry",
+    "ReplicaStats",
+    "Replica",
+    "ReplicaUnavailable",
+    "LocalReplica",
+    "HTTPReplica",
+    "FleetRouter",
+    "HedgeConfig",
+    "RoutingPolicy",
+    "RoundRobinPolicy",
+    "LeastLoadedPolicy",
+    "PrefixAffinityPolicy",
+    "POLICIES",
+]

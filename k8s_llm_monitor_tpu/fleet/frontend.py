@@ -1,0 +1,102 @@
+"""Router-role frontend: the fleet behind the standard monitor HTTP API.
+
+``FleetAnalysis`` duck-types the slice of ``AnalysisEngine`` the HTTP
+handlers call (``query`` / ``query_stream`` / ``analyze``), delegating to a
+``FleetRouter`` over HTTP replicas instead of a local engine.  A router
+process therefore serves the *same* ``/api/v1/query`` and
+``/api/v1/analyze`` contract as a replica — clients and dashboards don't
+know which tier they're talking to.
+
+It deliberately has no ``backend`` attribute: ``MonitorServer`` discovers a
+local engine through ``analysis.backend``, and a router has none — its
+health comes from the registry (``analysis.router``), wired into
+``health_snapshot`` and the exporter's fleet gauges.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from k8s_llm_monitor_tpu.fleet.registry import ReplicaRegistry
+from k8s_llm_monitor_tpu.fleet.replica import HTTPReplica
+from k8s_llm_monitor_tpu.fleet.router import FleetRouter, HedgeConfig
+from k8s_llm_monitor_tpu.monitor.models import (AnalysisRequest,
+                                                AnalysisResponse)
+
+logger = logging.getLogger("fleet.frontend")
+
+
+class FleetAnalysis:
+    """AnalysisEngine-shaped facade over a ``FleetRouter``."""
+
+    def __init__(self, router: FleetRouter):
+        self.router = router
+
+    @staticmethod
+    def _to_response(payload: dict) -> AnalysisResponse:
+        """Rehydrate a replica's JSON reply; the timestamp is re-stamped
+        locally (the wire value is a string, and callers only log it)."""
+        payload = payload or {}
+        return AnalysisResponse(
+            request_id=str(payload.get("request_id", "")),
+            status=str(payload.get("status", "error")),
+            result=payload.get("result") or {},
+            error=str(payload.get("error", "")),
+            error_kind=str(payload.get("error_kind", "")),
+        )
+
+    def query(self, question: str) -> AnalysisResponse:
+        return self._to_response(self.router.query(question))
+
+    def query_stream(self, question: str):
+        return self.router.query_stream(question)
+
+    def analyze(self, request: AnalysisRequest) -> AnalysisResponse:
+        return self._to_response(self.router.analyze({
+            "type": request.type,
+            "parameters": request.parameters,
+            "context": request.context,
+        }))
+
+    def close(self) -> None:
+        self.router.registry.stop_probes()
+        for rid in self.router.registry.ids():
+            entry = self.router.registry.get(rid)
+            if entry is not None:
+                entry.replica.close()
+
+
+def build_router_server(config, web_dir=None):
+    """Wire a router-role ``MonitorServer``: HTTP replica adapters from
+    ``config.fleet.replicas`` → registry (+ background probes) → router →
+    ``FleetAnalysis`` behind the standard HTTP API.  No cluster client and
+    no metrics manager — a router routes; replicas analyze."""
+    from k8s_llm_monitor_tpu.monitor.server import MonitorServer
+
+    fcfg = config.fleet
+    if not fcfg.replicas:
+        raise ValueError(
+            "router role needs fleet.replicas (comma-separated URLs via "
+            "FLEET_REPLICAS or the fleet: config block)")
+    registry = ReplicaRegistry(
+        breaker_failures=fcfg.breaker_failures,
+        breaker_cooldown_s=fcfg.breaker_cooldown_s)
+    for i, url in enumerate(fcfg.replicas):
+        registry.add(HTTPReplica(
+            f"replica-{i}", url,
+            connect_timeout_s=fcfg.connect_timeout_s,
+            read_timeout_s=fcfg.read_timeout_s))
+    router = FleetRouter(
+        registry, policy=fcfg.policy,
+        hedge=HedgeConfig(enabled=fcfg.hedge_enabled,
+                          min_delay_s=fcfg.hedge_min_delay_s,
+                          fixed_delay_s=fcfg.hedge_fixed_delay_s),
+        max_failovers=fcfg.max_failovers,
+        affinity_prefix_tokens=fcfg.affinity_prefix_tokens)
+    registry.refresh()
+    registry.start_probes(interval_s=fcfg.probe_interval_s)
+    logger.info("router fronting %d replica(s), policy=%s, hedging=%s",
+                len(registry), fcfg.policy,
+                "on" if fcfg.hedge_enabled else "off")
+    return MonitorServer(
+        config=config, analysis=FleetAnalysis(router), web_dir=web_dir)

@@ -1,0 +1,207 @@
+"""Replica adapters: one interface, two transports.
+
+``LocalReplica`` wraps an in-process ``EngineService`` (or a supervised
+``EngineSupervisor``) so tests and the bench can run a 2–4 replica fleet in
+one CPU process — it speaks the token-level generation interface the
+router's failover/hedging machinery needs (``generate`` → ``RequestHandle``).
+
+``HTTPReplica`` fronts a remote monitor-server replica over its existing
+HTTP API: ``/readyz`` + ``/api/v1/stats`` for probing (GETs, retried
+through the shared ``Backoff`` budget), ``/api/v1/query`` SSE and
+``/api/v1/analyze`` for traffic (POSTs, never retried — the router's
+failover owns re-dispatch).  All calls carry explicit socket timeouts via
+``monitor.client.ApiClient``.
+
+Capability split: LocalReplica is token-level (``supports_tokens``),
+HTTPReplica is text-level (``supports_query`` — the wire protocol streams
+answer-text deltas, not token ids).  The router routes each request shape
+over the replicas that support it.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from k8s_llm_monitor_tpu.fleet.registry import ReplicaStats
+
+logger = logging.getLogger("fleet.replica")
+
+
+class ReplicaUnavailable(RuntimeError):
+    """The replica could not take this request (connection refused, died,
+    adapter closed).  Routing-level signal: try another replica."""
+
+
+class Replica:
+    """Adapter interface the registry probes and the router dispatches on."""
+
+    replica_id: str = ""
+    supports_tokens = False
+    supports_query = False
+
+    # -- probing --------------------------------------------------------
+
+    def readyz(self) -> bool:
+        raise NotImplementedError
+
+    def stats(self) -> ReplicaStats:
+        raise NotImplementedError
+
+    # -- token-level generation (in-process replicas) -------------------
+
+    def generate(self, prompt_ids: list[int], sampling=None,
+                 request_id: str | None = None, deadline_s: float = 0.0):
+        """Submit one generation; returns a ``RequestHandle``."""
+        raise NotImplementedError(f"{self.replica_id}: token interface")
+
+    # -- text-level query API (HTTP replicas) ---------------------------
+
+    def query(self, question: str) -> dict:
+        raise NotImplementedError(f"{self.replica_id}: query interface")
+
+    def query_stream(self, question: str):
+        """Returns (request_id, model, iterator of text deltas)."""
+        raise NotImplementedError(f"{self.replica_id}: query interface")
+
+    def analyze(self, payload: dict) -> dict:
+        raise NotImplementedError(f"{self.replica_id}: query interface")
+
+    def close(self) -> None:
+        pass
+
+
+class LocalReplica(Replica):
+    """In-process replica: an ``EngineService`` (optionally owned by an
+    ``EngineSupervisor``) behind the replica interface.
+
+    ``kill()`` is the chaos hook: it stops the service abruptly so every
+    in-flight handle resolves with an error result — exactly what the
+    router's mid-stream failover must survive.
+    """
+
+    supports_tokens = True
+
+    def __init__(self, replica_id: str, service=None, supervisor=None):
+        assert (service is None) != (supervisor is None), \
+            "exactly one of service/supervisor"
+        self.replica_id = replica_id
+        self.supervisor = supervisor
+        self._service = service
+        self._killed = False
+
+    @property
+    def service(self):
+        if self.supervisor is not None:
+            return self.supervisor.service
+        return self._service
+
+    def readyz(self) -> bool:
+        if self._killed:
+            return False
+        svc = self.service
+        if svc is None:
+            return False
+        snap = svc.health.snapshot()
+        ready = bool(snap["ready"])
+        if self.supervisor is not None:
+            ready = ready and self.supervisor.snapshot()["state"] == "serving"
+        return ready
+
+    def stats(self) -> ReplicaStats:
+        svc = self.service
+        if svc is None:
+            raise ReplicaUnavailable(f"{self.replica_id}: no service")
+        engine = svc.engine
+        pc = engine.prefix_cache
+        return ReplicaStats(
+            queue_depth=engine.queue_depth,
+            queue_tokens=engine.queue_tokens,
+            busy_slots=engine.active_slots,
+            total_slots=engine.ecfg.max_slots,
+            prefix_hits=pc.hits if pc is not None else 0,
+            prefix_misses=pc.misses if pc is not None else 0,
+        )
+
+    def generate(self, prompt_ids: list[int], sampling=None,
+                 request_id: str | None = None, deadline_s: float = 0.0):
+        if self._killed:
+            raise ReplicaUnavailable(f"{self.replica_id}: killed")
+        try:
+            if self.supervisor is not None:
+                return self.supervisor.submit(
+                    prompt_ids, sampling, request_id=request_id,
+                    deadline_s=deadline_s)
+            return self.service.submit(
+                prompt_ids, sampling, request_id=request_id,
+                deadline_s=deadline_s)
+        except RuntimeError as exc:
+            # Dead service: a routing fact, not a caller error.
+            raise ReplicaUnavailable(str(exc)) from exc
+
+    def kill(self, reason: str = "injected replica death") -> None:
+        """Chaos hook: die abruptly.  Handles for in-flight generations
+        resolve with error results (the router's failover trigger)."""
+        self._killed = True
+        logger.warning("replica %s killed: %s", self.replica_id, reason)
+        svc = self.service
+        if svc is not None:
+            svc.stop(timeout=10.0)
+
+    def close(self) -> None:
+        self._killed = True
+        if self.supervisor is not None:
+            self.supervisor.shutdown(grace_s=0.0)
+        elif self._service is not None:
+            self._service.stop(timeout=5.0)
+
+
+class HTTPReplica(Replica):
+    """Remote monitor-server replica over its HTTP API (SSE streaming for
+    queries; explicit timeouts on every socket via ``ApiClient``)."""
+
+    supports_query = True
+
+    def __init__(self, replica_id: str, base_url: str, *,
+                 connect_timeout_s: float = 2.0, read_timeout_s: float = 30.0,
+                 client=None):
+        from k8s_llm_monitor_tpu.monitor.client import ApiClient
+
+        self.replica_id = replica_id
+        self.base_url = base_url.rstrip("/")
+        self.client = client or ApiClient(
+            self.base_url,
+            connect_timeout_s=connect_timeout_s,
+            read_timeout_s=read_timeout_s)
+
+    def readyz(self) -> bool:
+        return self.client.readyz()
+
+    def stats(self) -> ReplicaStats:
+        return ReplicaStats.from_payload(self.client.stats())
+
+    def query(self, question: str) -> dict:
+        from k8s_llm_monitor_tpu.monitor.client import ApiConnectionError
+
+        try:
+            return self.client.query(question)
+        except ApiConnectionError as exc:
+            raise ReplicaUnavailable(str(exc)) from exc
+
+    def query_stream(self, question: str):
+        from k8s_llm_monitor_tpu.monitor.client import ApiConnectionError
+
+        try:
+            return self.client.query_stream(question)
+        except ApiConnectionError as exc:
+            raise ReplicaUnavailable(str(exc)) from exc
+
+    def analyze(self, payload: dict) -> dict:
+        from k8s_llm_monitor_tpu.monitor.client import ApiConnectionError
+
+        try:
+            return self.client.analyze(payload)
+        except ApiConnectionError as exc:
+            raise ReplicaUnavailable(str(exc)) from exc
+
+    def close(self) -> None:
+        self.client.close()

@@ -1,0 +1,642 @@
+"""Fleet router: policy-ranked dispatch with hedging and mid-stream failover.
+
+Routing policies (pluggable, ranked candidate lists — dispatch walks the
+ranking so a refused/overloaded candidate falls through to the next):
+
+* ``round_robin`` — baseline rotation; the control arm for the affinity
+  hit-rate comparison.
+* ``least_loaded`` — weighted load score from each replica's stats
+  snapshot: queue-token backlog + busy-slot pressure + router-side inflight.
+* ``affinity`` — rendezvous (highest-random-weight) hashing on a
+  prompt-prefix digest, so same-prefix requests land on the replica whose
+  ``PrefixCache`` already holds their pages.  Saturated preferred replicas
+  spill to the least-loaded ranking (hot cache is worth nothing if the
+  request queues behind a full batch).
+
+Hedged dispatch (token-level path): when the primary has produced no token
+after the EMA-p95 TTFT delay, a second replica gets the same request; the
+first to produce a token wins and the loser is cancelled.  p95 is estimated
+online as ``m + k·d`` where ``m`` is a TTFT EMA and ``d`` an EMA of absolute
+deviation (for a normal tail, sigma ≈ 1.4826·MAD and p95 ≈ m + 1.645·sigma
+≈ m + 2.45·d; ``k`` defaults to 3.0 for safety against hedging storms).
+
+Mid-stream failover (token-level path): a replica that dies mid-generation
+resolves its handle with an error result; the pump resubmits to the next
+healthy replica with the already-streamed tokens folded into the prompt and
+``max_tokens`` trimmed by the emitted count — the same idempotent-replay
+contract as ``serving/supervisor.py`` — so the caller's stream continues
+with zero duplicated and zero lost tokens.
+
+The text-level path (``query``/``query_stream``/``analyze`` over
+``HTTPReplica``) gets the same policy ranking and failover; a resumed SSE
+stream suppresses the already-delivered character prefix.  Hedging is
+token-level only (an SSE generator has no timed ``next``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import itertools
+import logging
+import threading
+import time
+from typing import Optional
+
+from k8s_llm_monitor_tpu.devtools.lockcheck import guarded_by, make_lock
+from k8s_llm_monitor_tpu.fleet.registry import Candidate, ReplicaRegistry
+from k8s_llm_monitor_tpu.resilience.errors import OverloadedError
+from k8s_llm_monitor_tpu.resilience.retry import CircuitOpen
+from k8s_llm_monitor_tpu.serving.engine import GenerationResult, SamplingParams
+from k8s_llm_monitor_tpu.serving.service import RequestHandle
+
+logger = logging.getLogger("fleet.router")
+
+
+# ---------------------------------------------------------------------------
+# Routing policies
+# ---------------------------------------------------------------------------
+
+
+def _load_score(c: Candidate) -> float:
+    """Weighted least-loaded signal: queue-token backlog dominates, busy
+    slots and router-side inflight break ties (a replica with a full batch
+    but an empty queue still beats one with a backlog)."""
+    slot_pressure = (c.stats.busy_slots / c.stats.total_slots
+                     if c.stats.total_slots else 0.0)
+    return c.stats.queue_tokens + 64.0 * slot_pressure + 16.0 * c.inflight
+
+
+class RoutingPolicy:
+    name = "base"
+
+    def rank(self, candidates: list[Candidate],
+             digest: bytes) -> list[Candidate]:
+        raise NotImplementedError
+
+    def preferred(self, candidates: list[Candidate],
+                  digest: bytes) -> Optional[str]:
+        """The replica this policy would ideally use (affinity accounting);
+        None when the policy has no cache-topology preference."""
+        return None
+
+
+class RoundRobinPolicy(RoutingPolicy):
+    name = "round_robin"
+
+    def __init__(self) -> None:
+        self._turn = itertools.count()
+
+    def rank(self, candidates: list[Candidate],
+             digest: bytes) -> list[Candidate]:
+        if not candidates:
+            return []
+        ordered = sorted(candidates, key=lambda c: c.replica_id)
+        k = next(self._turn) % len(ordered)
+        return ordered[k:] + ordered[:k]
+
+
+class LeastLoadedPolicy(RoutingPolicy):
+    name = "least_loaded"
+
+    def rank(self, candidates: list[Candidate],
+             digest: bytes) -> list[Candidate]:
+        return sorted(candidates,
+                      key=lambda c: (_load_score(c), c.replica_id))
+
+
+class PrefixAffinityPolicy(RoutingPolicy):
+    """Rendezvous hashing on the prompt-prefix digest.
+
+    Every (digest, replica) pair gets a deterministic weight; the highest
+    weight wins.  Replica loss only remaps the keys that pointed at the
+    lost replica (the consistent-hashing property), so a failover doesn't
+    shuffle the whole fleet's cache topology.  A saturated winner spills to
+    the least-loaded order, counted by the router as an affinity spill.
+    """
+
+    name = "affinity"
+
+    @staticmethod
+    def _weight(digest: bytes, replica_id: str) -> bytes:
+        return hashlib.sha256(digest + replica_id.encode()).digest()
+
+    @staticmethod
+    def _saturated(c: Candidate) -> bool:
+        return (c.stats.total_slots > 0
+                and c.stats.busy_slots >= c.stats.total_slots
+                and c.stats.queue_tokens > 0)
+
+    def rank(self, candidates: list[Candidate],
+             digest: bytes) -> list[Candidate]:
+        ranked = sorted(candidates,
+                        key=lambda c: self._weight(digest, c.replica_id),
+                        reverse=True)
+        if len(ranked) > 1 and self._saturated(ranked[0]):
+            relief = [c for c in ranked[1:] if not self._saturated(c)]
+            if relief:
+                spill = sorted(relief,
+                               key=lambda c: (_load_score(c), c.replica_id))
+                rest = [c for c in ranked if c not in spill]
+                ranked = spill + rest
+        return ranked
+
+    def preferred(self, candidates: list[Candidate],
+                  digest: bytes) -> Optional[str]:
+        if not candidates:
+            return None
+        best = max(candidates,
+                   key=lambda c: self._weight(digest, c.replica_id))
+        return best.replica_id
+
+
+POLICIES = {
+    "round_robin": RoundRobinPolicy,
+    "least_loaded": LeastLoadedPolicy,
+    "affinity": PrefixAffinityPolicy,
+}
+
+
+# ---------------------------------------------------------------------------
+# Router
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class HedgeConfig:
+    enabled: bool = False
+    min_delay_s: float = 0.05     # floor: never hedge faster than this
+    fixed_delay_s: float = 0.0    # >0 pins the delay (bench/tests)
+    p95_mult: float = 3.0         # k in delay = ttft_ema + k * dev_ema
+    cold_delay_s: float = 0.5     # before any TTFT sample exists
+
+
+@dataclasses.dataclass
+class _Flight:
+    """Pump-thread state for one fleet-level request (mirrors the
+    supervisor's ``_Tracked``: everything needed to replay elsewhere)."""
+
+    rid: str
+    prompt_ids: list[int]
+    sampling: SamplingParams
+    deadline_s: float
+    digest: bytes
+    handle: RequestHandle               # fleet-level, what the caller holds
+    inner: Optional[RequestHandle]      # current replica-level handle
+    replica_id: str
+    emitted: list[int] = dataclasses.field(default_factory=list)
+    prior: list[int] = dataclasses.field(default_factory=list)
+    attempts: int = 0                   # failovers consumed
+    cancelled: bool = False
+    dispatch_t0: float = 0.0
+
+
+_DONE = object()
+
+
+@guarded_by("_lock", "dispatches", "completed", "failed", "sheds",
+            "failovers", "hedges_fired", "hedges_won", "affinity_hits",
+            "affinity_spills", "_ttft_m", "_ttft_dev")
+class FleetRouter:
+    """Routes requests over a ``ReplicaRegistry`` with the selected policy,
+    per-replica circuit breaking, optional hedging, and mid-stream
+    failover.  Token-level entry point is ``submit()`` (returns a
+    ``RequestHandle``-compatible ticket); text-level entry points are
+    ``query``/``query_stream``/``analyze``."""
+
+    def __init__(self, registry: ReplicaRegistry, policy: str = "affinity",
+                 hedge: HedgeConfig | None = None, max_failovers: int = 2,
+                 affinity_prefix_tokens: int = 64,
+                 stall_timeout_s: float = 120.0):
+        if policy not in POLICIES:
+            raise ValueError(
+                f"unknown policy {policy!r} (have {sorted(POLICIES)})")
+        self.registry = registry
+        self.policy = POLICIES[policy]()
+        self.hedge = hedge or HedgeConfig()
+        self.max_failovers = max_failovers
+        self.affinity_prefix_tokens = affinity_prefix_tokens
+        self.stall_timeout_s = stall_timeout_s
+        self._ids = itertools.count()
+        # counters (exporter gauges)
+        self.dispatches = 0
+        self.completed = 0
+        self.failed = 0
+        self.sheds = 0
+        self.failovers = 0
+        self.hedges_fired = 0
+        self.hedges_won = 0
+        self.affinity_hits = 0
+        self.affinity_spills = 0
+        # online TTFT stats for the hedge delay
+        self._ttft_m: float | None = None
+        self._ttft_dev: float = 0.0
+        self._ttft_alpha = 0.2
+        # Created last (lockcheck construction rule).
+        self._lock = make_lock("fleet.router")
+
+    # -- shared plumbing -------------------------------------------------
+
+    def _bump(self, name: str, by: int = 1) -> None:
+        with self._lock:
+            setattr(self, name, getattr(self, name) + by)
+
+    def counters(self) -> dict:
+        with self._lock:
+            return {
+                "dispatches": self.dispatches,
+                "completed": self.completed,
+                "failed": self.failed,
+                "sheds": self.sheds,
+                "failovers": self.failovers,
+                "hedges_fired": self.hedges_fired,
+                "hedges_won": self.hedges_won,
+                "affinity_hits": self.affinity_hits,
+                "affinity_spills": self.affinity_spills,
+            }
+
+    def _token_digest(self, prompt_ids: list[int]) -> bytes:
+        head = prompt_ids[: self.affinity_prefix_tokens]
+        return hashlib.sha256(
+            b",".join(str(t).encode() for t in head)).digest()
+
+    @staticmethod
+    def _text_digest(question: str) -> bytes:
+        return hashlib.sha256(question[:256].encode()).digest()
+
+    def _note_ttft(self, dt: float) -> None:
+        a = self._ttft_alpha
+        with self._lock:
+            if self._ttft_m is None:
+                self._ttft_m = dt
+                self._ttft_dev = dt / 2.0
+            else:
+                self._ttft_m += a * (dt - self._ttft_m)
+                self._ttft_dev += a * (abs(dt - self._ttft_m)
+                                       - self._ttft_dev)
+
+    def hedge_delay_s(self) -> float:
+        """Current hedge trigger: EMA-p95 of TTFT (see module docstring),
+        or the configured fixed delay."""
+        if self.hedge.fixed_delay_s > 0:
+            return self.hedge.fixed_delay_s
+        with self._lock:
+            m, dev = self._ttft_m, self._ttft_dev
+        if m is None:
+            return max(self.hedge.min_delay_s, self.hedge.cold_delay_s)
+        return max(self.hedge.min_delay_s, m + self.hedge.p95_mult * dev)
+
+    def _ranked(self, digest: bytes,
+                need_tokens: bool) -> list[Candidate]:
+        cands = [c for c in self.registry.candidates()
+                 if (c.replica.supports_tokens if need_tokens
+                     else c.replica.supports_query)]
+        return self.policy.rank(cands, digest)
+
+    def _account_affinity(self, digest: bytes, chosen: str,
+                          candidates: list[Candidate]) -> None:
+        pref = self.policy.preferred(candidates, digest)
+        if pref is None:
+            return
+        self._bump("affinity_hits" if chosen == pref else "affinity_spills")
+
+    # -- token-level dispatch -------------------------------------------
+
+    def _dispatch_tokens(self, ranked: list[Candidate],
+                         prompt_ids: list[int], sampling: SamplingParams,
+                         request_id: str, deadline_s: float,
+                         exclude: frozenset[str] | set[str] = frozenset()):
+        """Try candidates in rank order; returns (replica_id, handle) or
+        (None, last_error).  Breaker gates each attempt."""
+        last_exc: Exception | None = None
+        for cand in ranked:
+            if cand.replica_id in exclude:
+                continue
+            entry = self.registry.get(cand.replica_id)
+            if entry is None:
+                continue
+            try:
+                entry.breaker.before_call()
+            except CircuitOpen as exc:
+                last_exc = exc
+                continue
+            try:
+                handle = cand.replica.generate(
+                    prompt_ids, sampling, request_id=request_id,
+                    deadline_s=deadline_s)
+            except OverloadedError as exc:
+                entry.breaker.record_success()  # alive, just shedding
+                last_exc = exc
+                continue
+            except Exception as exc:  # noqa: BLE001 — routing fact
+                entry.breaker.record_failure()
+                self.registry.mark_unready(cand.replica_id, str(exc))
+                last_exc = exc
+                continue
+            self.registry.note_dispatch(cand.replica_id)
+            self._bump("dispatches")
+            return cand.replica_id, handle
+        return None, last_exc
+
+    def submit(self, prompt_ids: list[int],
+               sampling: SamplingParams | None = None,
+               request_id: str | None = None,
+               deadline_s: float = 0.0) -> RequestHandle:
+        """Admit one generation into the fleet.  Raises ``OverloadedError``
+        when no replica will take it (counted as a shed); otherwise returns
+        a handle whose stream survives replica death transparently."""
+        sampling = sampling or SamplingParams()
+        rid = request_id or f"fleet-{next(self._ids)}"
+        digest = self._token_digest(prompt_ids)
+        ranked = self._ranked(digest, need_tokens=True)
+        chosen, handle = (None, None)
+        if ranked:
+            chosen, handle = self._dispatch_tokens(
+                ranked, prompt_ids, sampling, f"{rid}-a0", deadline_s)
+        if chosen is None:
+            self._bump("sheds")
+            err = handle  # last error from dispatch, or None when empty
+            if isinstance(err, OverloadedError):
+                raise err
+            raise OverloadedError(
+                f"no replica available ({err or 'fleet empty'})",
+                retriable=True, retry_after_s=1.0)
+        self._account_affinity(digest, chosen, ranked)
+
+        flight = _Flight(
+            rid=rid, prompt_ids=list(prompt_ids), sampling=sampling,
+            deadline_s=deadline_s, digest=digest,
+            handle=RequestHandle(rid, eos_id=None), inner=handle,
+            replica_id=chosen, dispatch_t0=time.monotonic())
+        flight.handle._cancel_fn = lambda _rid: self._cancel_flight(flight)
+        threading.Thread(target=self._pump, args=(flight,),
+                         name=f"fleet-pump-{rid}", daemon=True).start()
+        return flight.handle
+
+    def _cancel_flight(self, fl: _Flight) -> None:
+        fl.cancelled = True
+        inner = fl.inner
+        if inner is not None:
+            inner.cancel()
+
+    # -- pump: stream, hedge, fail over ---------------------------------
+
+    def _pump(self, fl: _Flight) -> None:
+        try:
+            while True:
+                outcome = self._consume(fl)
+                if outcome is _DONE:
+                    return
+                # Replica died mid-generation: fold emitted tokens into the
+                # prompt, trim the budget, resubmit elsewhere (supervisor
+                # replay contract, fleet-wide).
+                self.registry.note_done(fl.replica_id, ok=False)
+                self.registry.mark_unready(fl.replica_id, str(outcome))
+                self._bump("failovers")
+                fl.attempts += 1
+                if fl.cancelled:
+                    return self._fail(fl, "cancelled")
+                if fl.attempts > self.max_failovers:
+                    return self._fail(
+                        fl, f"failover budget exhausted: {outcome}")
+                remaining = fl.sampling.max_tokens - len(fl.emitted)
+                if remaining <= 0:
+                    return self._finish_trimmed(fl)
+                replay = dataclasses.replace(
+                    fl.sampling, max_tokens=remaining)
+                ranked = self._ranked(fl.digest, need_tokens=True)
+                chosen, handle = self._dispatch_tokens(
+                    ranked, fl.prompt_ids + fl.emitted, replay,
+                    f"{fl.rid}-a{fl.attempts}", fl.deadline_s,
+                    exclude={fl.replica_id})
+                if chosen is None:
+                    return self._fail(
+                        fl, f"no healthy replica for failover ({handle})")
+                logger.info("request %s failed over %s -> %s after %d tokens",
+                            fl.rid, fl.replica_id, chosen, len(fl.emitted))
+                fl.prior = list(fl.emitted)
+                fl.replica_id, fl.inner = chosen, handle
+                fl.dispatch_t0 = time.monotonic()
+        except Exception:  # noqa: BLE001 — a pump must never strand a caller
+            logger.exception("pump for %s crashed", fl.rid)
+            self._fail(fl, "router pump error")
+
+    def _consume(self, fl: _Flight):
+        """Stream one replica incarnation into the fleet handle.  Returns
+        ``_DONE`` on a delivered final result or an error-message string
+        when the replica died and a failover should run."""
+        inner = fl.inner
+        first = not fl.emitted
+        if (self.hedge.enabled and first and fl.attempts == 0
+                and not fl.cancelled):
+            hedged = self._maybe_hedge(fl)
+            if hedged is not None:
+                inner = hedged
+        last_progress = time.monotonic()
+        while True:
+            try:
+                tok = inner.poll_token(timeout=0.2)
+            except TimeoutError:
+                if (time.monotonic() - last_progress > self.stall_timeout_s
+                        and not fl.cancelled):
+                    inner.cancel()
+                    return "replica stalled (no token within "\
+                           f"{self.stall_timeout_s:.0f}s)"
+                continue
+            last_progress = time.monotonic()
+            if tok is None:
+                res = inner.result(timeout=10.0)
+                if res.finish_reason == "error" and not fl.cancelled:
+                    return res.error or "replica failed"
+                fl.handle._replay_prefix = list(fl.prior)
+                fl.handle._push([], res)
+                self.registry.note_done(
+                    fl.replica_id, ok=res.finish_reason != "error")
+                self._bump("completed")
+                return _DONE
+            if not fl.emitted and not fl.prior:
+                self._note_ttft(time.monotonic() - fl.dispatch_t0)
+            fl.emitted.append(tok)
+            fl.handle._push([tok], None)
+
+    def _maybe_hedge(self, fl: _Flight) -> Optional[RequestHandle]:
+        """Wait the hedge delay for a first token; past it, race a second
+        replica.  Returns the winning inner handle (the loser is cancelled)
+        or None when no hedge happened.  Any token seen here is forwarded
+        before returning, so ``_consume`` continues seamlessly."""
+        delay = self.hedge_delay_s()
+        primary = fl.inner
+        try:
+            tok = primary.poll_token(timeout=delay)
+        except TimeoutError:
+            tok = False  # no first token yet: hedge
+        if tok is not False:
+            if tok is not None:
+                self._note_ttft(time.monotonic() - fl.dispatch_t0)
+                fl.emitted.append(tok)
+                fl.handle._push([tok], None)
+            # else: stream ended inside the delay window (poll_token
+            # re-armed the end sentinel for _consume).  Nothing to hedge.
+            return None
+        ranked = self._ranked(fl.digest, need_tokens=True)
+        chosen, hedge_handle = self._dispatch_tokens(
+            ranked, fl.prompt_ids, fl.sampling, f"{fl.rid}-h",
+            fl.deadline_s, exclude={fl.replica_id})
+        if chosen is None:
+            return None
+        self._bump("hedges_fired")
+        winner_id, winner, loser_id, loser = self._race(
+            fl.replica_id, primary, chosen, hedge_handle)
+        if winner is hedge_handle:
+            self._bump("hedges_won")
+        loser.cancel()
+        # The loser keeps running to its (cancelled) completion on its own
+        # replica; release the router-side inflight slot now.  Cancellation
+        # is not a replica failure.
+        self.registry.note_done(loser_id, ok=True)
+        fl.replica_id, fl.inner = winner_id, winner
+        return winner
+
+    @staticmethod
+    def _race(rid_a: str, ha: RequestHandle, rid_b: str, hb: RequestHandle):
+        """First handle to show life (token or end-of-stream) wins.  A
+        token seen here is NOT consumed — poll_token re-arms nothing for
+        tokens, so peek by polling with a tiny timeout and pushing the
+        token back is unsafe; instead the race polls with ``poll_token``
+        and hands any consumed token straight back via the queue head."""
+        while True:
+            for rid, h in ((rid_a, ha), (rid_b, hb)):
+                try:
+                    tok = h.poll_token(timeout=0.005)
+                except TimeoutError:
+                    continue
+                # Re-queue what we consumed so the winner's stream is
+                # intact for _consume (FIFO queue: only safe because the
+                # race is the sole consumer until it returns).
+                if tok is not None:
+                    h._tokens.queue.appendleft(tok)
+                else:
+                    pass  # poll_token already re-armed the end sentinel
+                other_rid, other = (rid_b, hb) if h is ha else (rid_a, ha)
+                return rid, h, other_rid, other
+
+    def _fail(self, fl: _Flight, msg: str) -> None:
+        self._bump("failed")
+        fl.handle._replay_prefix = []
+        fl.handle._push([], GenerationResult(
+            request_id=fl.rid, token_ids=list(fl.emitted),
+            finish_reason="error", ttft_s=0.0, latency_s=0.0, error=msg))
+
+    def _finish_trimmed(self, fl: _Flight) -> None:
+        """The dying replica had already emitted the full budget: complete
+        with what was streamed (nothing left to regenerate)."""
+        fl.handle._replay_prefix = []
+        fl.handle._push([], GenerationResult(
+            request_id=fl.rid, token_ids=list(fl.emitted),
+            finish_reason="length", ttft_s=0.0, latency_s=0.0))
+        self._bump("completed")
+
+    # -- text-level routing (HTTP replicas) ------------------------------
+
+    def _dispatch_text(self, digest: bytes, op):
+        """Run ``op(replica)`` on the first candidate that takes it;
+        connection-level failures fall through to the next candidate."""
+        ranked = self._ranked(digest, need_tokens=False)
+        last_exc: Exception | None = None
+        for cand in ranked:
+            entry = self.registry.get(cand.replica_id)
+            if entry is None:
+                continue
+            try:
+                entry.breaker.before_call()
+            except CircuitOpen as exc:
+                last_exc = exc
+                continue
+            self.registry.note_dispatch(cand.replica_id)
+            self._bump("dispatches")
+            try:
+                out = op(cand.replica)
+            except OverloadedError as exc:
+                entry.breaker.record_success()
+                self.registry.note_done(cand.replica_id, ok=True)
+                last_exc = exc
+                continue
+            except Exception as exc:  # noqa: BLE001 — routing fact
+                self.registry.note_done(cand.replica_id, ok=False)
+                self.registry.mark_unready(cand.replica_id, str(exc))
+                last_exc = exc
+                continue
+            self._account_affinity(digest, cand.replica_id, ranked)
+            return cand.replica_id, out
+        self._bump("sheds")
+        if isinstance(last_exc, OverloadedError):
+            raise last_exc
+        raise OverloadedError(
+            f"no replica available ({last_exc or 'fleet empty'})",
+            retriable=True, retry_after_s=1.0)
+
+    def query(self, question: str) -> dict:
+        rid, payload = self._dispatch_text(
+            self._text_digest(question), lambda r: r.query(question))
+        self.registry.note_done(rid, ok=True)
+        return payload
+
+    def analyze(self, payload: dict) -> dict:
+        rid, out = self._dispatch_text(
+            self._text_digest(payload.get("type", "")),
+            lambda r: r.analyze(payload))
+        self.registry.note_done(rid, ok=True)
+        return out
+
+    def query_stream(self, question: str):
+        """Returns (request_id, model, delta iterator).  The iterator fails
+        over mid-stream: a new replica re-answers and the already-delivered
+        character prefix is suppressed, so the caller sees a contiguous
+        stream (exact for deterministic backends — greedy decode over the
+        same evidence; the token-level path is the strict contract)."""
+        digest = self._text_digest(question)
+        rid, (rep_rid, model, chunks) = self._dispatch_text(
+            digest, lambda r: r.query_stream(question))
+
+        def deltas():
+            nonlocal rid, chunks
+            emitted = 0
+            skip = 0
+            attempts = 0
+            while True:
+                try:
+                    for delta in chunks:
+                        if skip:
+                            take = delta[skip:]
+                            skip = max(0, skip - len(delta))
+                            delta = take
+                        if delta:
+                            emitted += len(delta)
+                            yield delta
+                    self.registry.note_done(rid, ok=True)
+                    self._bump("completed")
+                    return
+                except GeneratorExit:
+                    if hasattr(chunks, "close"):
+                        chunks.close()
+                    self.registry.note_done(rid, ok=True)
+                    raise
+                except Exception as exc:  # noqa: BLE001 — failover trigger
+                    self.registry.note_done(rid, ok=False)
+                    self.registry.mark_unready(rid, str(exc))
+                    self._bump("failovers")
+                    attempts += 1
+                    if attempts > self.max_failovers:
+                        self._bump("failed")
+                        raise
+                    try:
+                        rid, (_, _, chunks) = self._dispatch_text(
+                            digest, lambda r: r.query_stream(question))
+                    except OverloadedError:
+                        self._bump("failed")
+                        raise exc from None
+                    skip = emitted
+                    logger.info("stream %s failed over mid-answer after "
+                                "%d chars", rep_rid, emitted)
+
+        return rep_rid, model, deltas()

@@ -400,3 +400,221 @@ class Client:
 
     def watch(self, kind: str, namespace: str) -> WatchStream:
         return self.backend.watch(kind, namespace)
+
+
+# ---------------------------------------------------------------------------
+# monitor-API client (the server's own HTTP surface)
+# ---------------------------------------------------------------------------
+
+
+class ApiConnectionError(ClusterError):
+    """The monitor server could not be reached (or died mid-response).
+
+    Connection-level, not application-level: the replica is a routing
+    fact for the fleet tier, which maps this to ``ReplicaUnavailable``.
+    """
+
+
+class ApiClient:
+    """HTTP client for the monitor server's own API with the kube_rest
+    retry discipline: every socket carries an explicit timeout, GETs
+    (probes: ``/readyz``, ``/health``, ``/api/v1/stats``) retry through a
+    ``resilience.Backoff`` budget, and POSTs (``/api/v1/query``,
+    ``/api/v1/analyze``) are NEVER retried — a query may have side effects
+    (admission, generation) and re-dispatch belongs to the fleet router,
+    which owns idempotent failover.
+
+    Probe GETs use the short connect timeout (a dead replica must not
+    stall the probe loop); query POSTs use the long read timeout, which
+    for SSE applies *between* reads so a healthy slow stream is fine.
+    """
+
+    def __init__(self, base_url: str, *, connect_timeout_s: float = 2.0,
+                 read_timeout_s: float = 30.0, backoff=None):
+        import random as _random
+
+        from k8s_llm_monitor_tpu.resilience.retry import Backoff
+
+        self.base_url = base_url.rstrip("/")
+        self.connect_timeout_s = connect_timeout_s
+        self.read_timeout_s = read_timeout_s
+        self.backoff = backoff or Backoff(
+            base_s=0.1, cap_s=2.0, attempts=3, rng=_random.Random(0))
+
+    # -- plumbing ------------------------------------------------------------
+
+    def _url(self, path: str) -> str:
+        return f"{self.base_url}{path}"
+
+    def _open(self, path: str, body: dict[str, Any] | None = None,
+              timeout: float = 2.0):
+        import json as _json
+        import urllib.request
+
+        data = None
+        headers = {}
+        if body is not None:
+            data = _json.dumps(body).encode()
+            headers["Content-Type"] = "application/json"
+        req = urllib.request.Request(self._url(path), data=data,
+                                     headers=headers)
+        return urllib.request.urlopen(req, timeout=timeout)  # noqa: S310
+
+    @staticmethod
+    def _overloaded_from(exc) -> "OverloadedError | None":
+        """Map a 429/503 reply carrying shed evidence to OverloadedError."""
+        import json as _json
+
+        from k8s_llm_monitor_tpu.resilience.errors import OverloadedError
+
+        if exc.code not in (429, 503):
+            return None
+        try:
+            payload = _json.loads(exc.read().decode())
+        except Exception:  # noqa: BLE001 — plain-text 503s exist
+            payload = {}
+        if exc.code == 503 and payload.get("error_kind") != "overloaded":
+            return None
+        return OverloadedError(
+            payload.get("reason", f"HTTP {exc.code}"),
+            queue_depth=int(payload.get("queue_depth", 0)),
+            queue_tokens=int(payload.get("queue_tokens", 0)),
+            retriable=exc.code == 429,
+            retry_after_s=float(payload.get("retry_after_s", 1.0)),
+        )
+
+    def _get_json(self, path: str) -> dict[str, Any]:
+        """GET with the Backoff retry budget (idempotent: safe to retry)."""
+        import json as _json
+        import time as _time
+        import urllib.error
+
+        delays = list(self.backoff.delays()) + [None]
+        last: Exception | None = None
+        for delay in delays:
+            try:
+                with self._open(path, timeout=self.connect_timeout_s) as resp:
+                    return _json.loads(resp.read().decode())
+            except urllib.error.HTTPError as exc:
+                if exc.code < 500:  # the server answered; don't hammer it
+                    raise ApiConnectionError(
+                        f"GET {path}: HTTP {exc.code}") from exc
+                last = exc
+            except (urllib.error.URLError, OSError, ValueError) as exc:
+                last = exc
+            if delay is not None:
+                _time.sleep(delay)
+        raise ApiConnectionError(f"GET {path}: {last}") from last
+
+    def _post_json(self, path: str, body: dict[str, Any],
+                   timeout: float) -> dict[str, Any]:
+        """POST, never retried.  4xx/5xx JSON bodies are returned (the
+        API ships structured error responses); overload replies raise."""
+        import json as _json
+        import urllib.error
+
+        try:
+            with self._open(path, body=body, timeout=timeout) as resp:
+                return _json.loads(resp.read().decode())
+        except urllib.error.HTTPError as exc:
+            over = self._overloaded_from(exc)
+            if over is not None:
+                raise over from exc
+            try:
+                return _json.loads(exc.read().decode())
+            except Exception:  # noqa: BLE001
+                raise ApiConnectionError(
+                    f"POST {path}: HTTP {exc.code}") from exc
+        except (urllib.error.URLError, OSError) as exc:
+            raise ApiConnectionError(f"POST {path}: {exc}") from exc
+
+    # -- probes (GET, retried) ----------------------------------------------
+
+    def readyz(self) -> bool:
+        import urllib.error
+
+        try:
+            with self._open("/readyz", timeout=self.connect_timeout_s) as r:
+                return r.status == 200
+        except urllib.error.HTTPError:
+            return False
+        except (urllib.error.URLError, OSError) as exc:
+            raise ApiConnectionError(f"GET /readyz: {exc}") from exc
+
+    def health(self) -> dict[str, Any]:
+        return self._get_json("/health")
+
+    def stats(self) -> dict[str, Any]:
+        return self._get_json("/api/v1/stats")
+
+    # -- queries (POST, never retried) ---------------------------------------
+
+    def query(self, question: str) -> dict[str, Any]:
+        return self._post_json("/api/v1/query", {"question": question},
+                               timeout=self.read_timeout_s)
+
+    def analyze(self, payload: dict[str, Any]) -> dict[str, Any]:
+        return self._post_json("/api/v1/analyze", payload,
+                               timeout=self.read_timeout_s)
+
+    def query_stream(self, question: str):
+        """POST /api/v1/query with ``stream: true``; returns
+        ``(request_id, model, deltas)`` where ``deltas`` yields answer-text
+        chunks.  Mid-stream socket death raises ``ApiConnectionError`` from
+        the iterator — the router's failover trigger."""
+        import json as _json
+        import urllib.error
+
+        try:
+            resp = self._open("/api/v1/query",
+                              body={"question": question, "stream": True},
+                              timeout=self.read_timeout_s)
+        except urllib.error.HTTPError as exc:
+            over = self._overloaded_from(exc)
+            if over is not None:
+                raise over from exc
+            raise ApiConnectionError(
+                f"POST /api/v1/query: HTTP {exc.code}") from exc
+        except (urllib.error.URLError, OSError) as exc:
+            raise ApiConnectionError(f"POST /api/v1/query: {exc}") from exc
+
+        def events():
+            import http.client
+
+            try:
+                with resp:
+                    for raw in resp:
+                        line = raw.decode("utf-8", "replace").strip()
+                        if not line.startswith("data: "):
+                            continue
+                        yield _json.loads(line[len("data: "):])
+            except (OSError, ValueError, http.client.HTTPException) as exc:
+                # IncompleteRead (a HTTPException, not an OSError) is what
+                # a replica death mid-chunk actually raises.
+                raise ApiConnectionError(f"stream died: {exc}") from exc
+
+        stream = events()
+        first = next(stream, None)
+        if first is None:
+            raise ApiConnectionError("stream ended before any event")
+        request_id = first.get("request_id", "")
+        model = first.get("model", "")
+
+        def deltas():
+            ev = first
+            while ev is not None:
+                if ev.get("done"):
+                    return
+                delta = ev.get("delta", "")
+                if delta:
+                    yield delta
+                ev = next(stream, None)
+            # EOF without the done event: the replica died mid-answer but
+            # the response ended cleanly.  Surface a dead stream so the
+            # caller fails over instead of accepting a truncated answer.
+            raise ApiConnectionError("stream ended without done event")
+
+        return request_id, model, deltas()
+
+    def close(self) -> None:  # symmetry with pooled clients; nothing held
+        pass

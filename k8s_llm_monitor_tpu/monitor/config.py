@@ -163,6 +163,37 @@ class LifecycleConfig:
 
 
 @dataclass
+class FleetConfig:
+    """Fleet tier (fleet/): router role fronting N engine replicas.
+    New; no reference equivalent — the Go reference was single-process."""
+
+    # Replica base URLs the router fronts, e.g.
+    # "http://engine-0.engine:8080,http://engine-1.engine:8080"
+    # (FLEET_REPLICAS env, comma-separated).  Empty = this process is a
+    # plain replica; the router role refuses to start without it.
+    replicas: list[str] = field(default_factory=list)
+    policy: str = "affinity"  # affinity | least_loaded | round_robin
+    # Prompt-prefix length (tokens) hashed for affinity routing; keep at
+    # or above the shared cluster-context preamble so same-context queries
+    # stay on the replica whose PrefixCache holds their pages.
+    affinity_prefix_tokens: int = 64
+    probe_interval_s: float = 5.0
+    connect_timeout_s: float = 2.0
+    read_timeout_s: float = 60.0
+    # Per-replica circuit breaker (resilience/retry.py semantics).
+    breaker_failures: int = 3
+    breaker_cooldown_s: float = 5.0
+    # Mid-stream failover budget per request.
+    max_failovers: int = 2
+    # Hedged dispatch: fire a second replica when the first shows no token
+    # after the EMA-p95 TTFT delay (docs/fleet.md).  fixed_delay_s > 0
+    # pins the delay (bench/tests); 0 uses the online estimate.
+    hedge_enabled: bool = False
+    hedge_min_delay_s: float = 0.05
+    hedge_fixed_delay_s: float = 0.0
+
+
+@dataclass
 class LoggingConfig:
     level: str = "info"
     format: str = "json"  # ref config.go default
@@ -179,6 +210,7 @@ class Config:
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     analysis: AnalysisConfig = field(default_factory=AnalysisConfig)
     lifecycle: LifecycleConfig = field(default_factory=LifecycleConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
 
 

@@ -216,6 +216,56 @@ def _manager_metrics(w: _Writer, manager) -> None:
              [("", len(manager.get_uav_metrics()))])
 
 
+def _fleet_metrics(w: _Writer, router) -> None:
+    """Fleet-tier gauges (router role): per-replica dispatch state plus
+    the router's hedging/failover/affinity counters (PR 5)."""
+    snap = router.registry.snapshot()
+    ready, inflight, hit_rate, dispatches, failures = [], [], [], [], []
+    for rid, rep in sorted(snap.items()):
+        label = f'{{replica="{rid}"}}'
+        ready.append((label, 1 if rep["ready"] else 0))
+        inflight.append((label, rep["inflight"]))
+        hit_rate.append((label, rep["prefix_hit_rate"]))
+        dispatches.append((label, rep["dispatches"]))
+        failures.append((label, rep["failures"]))
+    if ready:
+        w.metric("fleet_replica_ready", "gauge",
+                 "Replica readiness as the router sees it", ready)
+        w.metric("fleet_replica_inflight", "gauge",
+                 "Router-side requests in flight per replica", inflight)
+        w.metric("fleet_replica_prefix_hit_rate", "gauge",
+                 "Prefix-cache hit rate from the replica's last stats probe",
+                 hit_rate)
+        w.metric("fleet_replica_dispatches_total", "counter",
+                 "Requests the router dispatched to each replica",
+                 dispatches)
+        w.metric("fleet_replica_failures_total", "counter",
+                 "Dispatch/stream failures the router observed per replica",
+                 failures)
+    c = router.counters()
+    w.metric("fleet_affinity_hits_total", "counter",
+             "Dispatches that landed on the policy's preferred replica",
+             [("", c["affinity_hits"])])
+    w.metric("fleet_affinity_spills_total", "counter",
+             "Dispatches diverted off the preferred replica (saturation or "
+             "breaker)", [("", c["affinity_spills"])])
+    w.metric("fleet_hedges_fired_total", "counter",
+             "Hedged dispatches fired after the EMA-p95 TTFT delay",
+             [("", c["hedges_fired"])])
+    w.metric("fleet_hedges_won_total", "counter",
+             "Hedged dispatches whose second replica produced the first "
+             "token", [("", c["hedges_won"])])
+    w.metric("fleet_failovers_total", "counter",
+             "Mid-stream failovers (replica died; request resumed "
+             "elsewhere)", [("", c["failovers"])])
+    w.metric("fleet_sheds_total", "counter",
+             "Requests refused because no replica would take them",
+             [("", c["sheds"])])
+    w.metric("fleet_hedge_delay_seconds", "gauge",
+             "Current hedge trigger delay (EMA-p95 of TTFT)",
+             [("", round(router.hedge_delay_s(), 6))])
+
+
 def _device_metrics(w: _Writer) -> None:
     try:
         import jax
@@ -265,6 +315,9 @@ def render_prometheus(srv: "MonitorServer") -> str:
     breaker = getattr(getattr(srv.client, "backend", None), "breaker", None)
     if breaker is not None:
         _kube_breaker_metrics(w, breaker)
+    router = getattr(srv.analysis, "router", None)
+    if router is not None:
+        _fleet_metrics(w, router)
     if srv.manager is not None:
         _manager_metrics(w, srv.manager)
     _device_metrics(w)

@@ -147,6 +147,59 @@ class MonitorServer:
                 "trips": breaker.trips,
                 "rejections": breaker.rejections,
             }
+        router = self.fleet_router()
+        if router is not None:
+            replicas = router.registry.snapshot()
+            snap["fleet"] = {
+                "replicas": replicas,
+                "counters": router.counters(),
+            }
+            # A router with zero ready replicas serves nothing: not ready.
+            if not any(r["ready"] for r in replicas.values()):
+                snap["ready"] = False
+                snap["status"] = "degraded"
+                if not snap["reason"]:
+                    snap["reason"] = "no ready fleet replicas"
+        return snap
+
+    def fleet_router(self):
+        """The FleetRouter, when this process runs the router role."""
+        return getattr(self.analysis, "router", None)
+
+    def stats_snapshot(self) -> dict[str, Any]:
+        """Load-signal snapshot — the body of ``GET /api/v1/stats``.  The
+        ``engine`` block is the fleet router's per-replica probe payload
+        (queue backlog, slot occupancy, prefix-cache hit counters); the
+        ``fleet`` block appears on router-role processes."""
+        snap: dict[str, Any] = {
+            "engine": None,
+            "fleet": None,
+            "timestamp": _now(),
+        }
+        svc = self.engine_service()
+        if svc is not None:
+            engine = svc.engine
+            pc = engine.prefix_cache
+            snap["engine"] = {
+                "queue_depth": engine.queue_depth,
+                "queue_tokens": engine.queue_tokens,
+                "busy_slots": engine.active_slots,
+                "total_slots": engine.ecfg.max_slots,
+                "prefix_deferrals": engine.prefix_deferrals,
+                "prefix_cache": {
+                    "hits": pc.hits,
+                    "misses": pc.misses,
+                    "evictions": pc.evictions,
+                    "entries": len(pc),
+                } if pc is not None else None,
+            }
+        router = self.fleet_router()
+        if router is not None:
+            snap["fleet"] = {
+                "replicas": router.registry.snapshot(),
+                "counters": router.counters(),
+                "hedge_delay_s": round(router.hedge_delay_s(), 4),
+            }
         return snap
 
     # -- lifecycle -------------------------------------------------------------
@@ -187,6 +240,7 @@ class MonitorServer:
 _ROUTES: dict[tuple[str, str], str] = {
     ("GET", "/health"): "h_health",
     ("GET", "/readyz"): "h_readyz",
+    ("GET", "/api/v1/stats"): "h_stats",
     ("GET", "/metrics"): "h_prometheus",
     ("POST", "/debug/profile"): "h_profile",
     ("GET", "/api/v1/cluster/status"): "h_cluster_status",
@@ -357,6 +411,11 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
                 },
                 status=200 if snap["ready"] else 503,
             )
+
+        def h_stats(self) -> None:
+            """Load signal: engine queue/slot/prefix-cache counters (what
+            the fleet router ranks replicas on), fleet state on routers."""
+            self._send_json(srv.stats_snapshot())
 
         def h_prometheus(self) -> None:
             # Self-observability the reference never had (SURVEY §5.5):

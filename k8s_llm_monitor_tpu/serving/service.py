@@ -104,6 +104,24 @@ class RequestHandle:
                 return
             yield tok
 
+    def poll_token(self, timeout: Optional[float] = None) -> Optional[int]:
+        """Single-step variant of ``stream()``: the next token id, or None
+        once the stream has ended (idempotent — the end sentinel is re-armed
+        so callers racing several handles may poll past it).  Raises
+        TimeoutError when nothing arrives within ``timeout``; the fleet
+        router uses that to multiplex a hedged pair of handles from one
+        thread."""
+        try:
+            tok = self._tokens.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(
+                f"generation {self.request_id}: no token within "
+                f"{timeout}s") from None
+        if tok is None:
+            self._tokens.put(None)
+            return None
+        return tok
+
     def result(self, timeout: Optional[float] = None) -> GenerationResult:
         if not self._done.wait(timeout=timeout):
             raise TimeoutError(

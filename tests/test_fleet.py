@@ -1,0 +1,656 @@
+"""Fleet tier: routing policies, registry health/breakers, hedged dispatch,
+and mid-stream failover.
+
+Unit tests run on scripted fake replicas (a deterministic "model" whose next
+token is last-token+1, so the failover replay contract is checkable without
+an engine).  Acceptance tests run real in-process fleets: affinity must beat
+round-robin on prefix-cache hit rate, and killing a replica under >= 32
+concurrent streams must lose zero tokens (``make chaos-fleet``).
+"""
+
+import hashlib
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from k8s_llm_monitor_tpu.fleet import (
+    Candidate,
+    FleetRouter,
+    HedgeConfig,
+    LeastLoadedPolicy,
+    LocalReplica,
+    PrefixAffinityPolicy,
+    ReplicaRegistry,
+    ReplicaStats,
+    RoundRobinPolicy,
+)
+from k8s_llm_monitor_tpu.fleet.frontend import build_router_server
+from k8s_llm_monitor_tpu.fleet.replica import Replica
+from k8s_llm_monitor_tpu.models import llama
+from k8s_llm_monitor_tpu.models.config import ModelConfig
+from k8s_llm_monitor_tpu.monitor.analysis import AnalysisEngine, LocalEngineBackend
+from k8s_llm_monitor_tpu.monitor.config import Config, LLMConfig
+from k8s_llm_monitor_tpu.monitor.server import MonitorServer
+from k8s_llm_monitor_tpu.resilience.errors import OverloadedError
+from k8s_llm_monitor_tpu.serving.engine import (
+    EngineConfig,
+    GenerationResult,
+    InferenceEngine,
+    SamplingParams,
+)
+from k8s_llm_monitor_tpu.serving.service import EngineService, RequestHandle
+from k8s_llm_monitor_tpu.utils.tokenizer import ByteTokenizer
+
+CFG = ModelConfig(name="t", vocab_size=300, hidden_size=32,
+                  intermediate_size=64, num_layers=2, num_heads=4,
+                  num_kv_heads=2, dtype="float32", rope_theta=10_000.0)
+ECFG = dict(max_slots=4, num_blocks=64, block_size=8, max_blocks_per_seq=16,
+            prefill_buckets=(16,), max_prefills_per_step=4,
+            decode_steps_per_iter=4)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(jax.random.PRNGKey(0), CFG)
+
+
+def _naive_greedy(params, prompt, n):
+    seq = list(prompt)
+    for _ in range(n):
+        logits = llama.forward_full(params, CFG, jnp.asarray([seq], jnp.int32))
+        seq.append(int(jnp.argmax(logits[0, -1])))
+    return seq[len(prompt):]
+
+
+def _wait(cond, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# Scripted fakes
+# ---------------------------------------------------------------------------
+
+
+class ScriptedReplica(Replica):
+    """Token-level fake.  Its "model" is next = last + 1 (mod 997), so
+    folding emitted tokens into the prompt continues the sequence exactly
+    like a deterministic LM — the replay contract is checkable token by
+    token.  ``fail_after=n`` emits n tokens then resolves with an error
+    result (the router's failover trigger); ``stall`` never emits."""
+
+    supports_tokens = True
+
+    def __init__(self, rid, fail_after=None, stall=False, ready=True):
+        self.replica_id = rid
+        self.fail_after = fail_after
+        self.stall = stall
+        self.ready = ready
+        self.calls = []
+        self.cancelled = []
+
+    def readyz(self):
+        return self.ready
+
+    def stats(self):
+        return ReplicaStats(total_slots=4)
+
+    def generate(self, prompt_ids, sampling=None, request_id=None,
+                 deadline_s=0.0):
+        sampling = sampling or SamplingParams()
+        self.calls.append((list(prompt_ids), sampling, request_id))
+        h = RequestHandle(request_id or "r", eos_id=-1,
+                          cancel_fn=lambda rid: self.cancelled.append(rid))
+        if self.stall:
+            return h
+        start = prompt_ids[-1] if prompt_ids else 0
+        toks = [(start + 1 + i) % 997 for i in range(sampling.max_tokens)]
+        if self.fail_after is not None:
+            emit = toks[: self.fail_after]
+            for t in emit:
+                h._push([t], None)
+            h._push([], GenerationResult(
+                request_id=h.request_id, token_ids=list(emit),
+                finish_reason="error", ttft_s=0.0, latency_s=0.0,
+                error="injected death"))
+        else:
+            for t in toks:
+                h._push([t], None)
+            h._push([], GenerationResult(
+                request_id=h.request_id, token_ids=list(toks),
+                finish_reason="length", ttft_s=0.0, latency_s=0.0))
+        return h
+
+
+class ScriptedQueryReplica(Replica):
+    """Text-level fake for the query/stream routing path."""
+
+    supports_query = True
+
+    def __init__(self, rid, answer="hello world", fail_stream_after=None,
+                 ready=True):
+        self.replica_id = rid
+        self.answer = answer
+        self.fail_stream_after = fail_stream_after
+        self.ready = ready
+        self.queries = []
+
+    def readyz(self):
+        return self.ready
+
+    def stats(self):
+        return ReplicaStats(total_slots=4)
+
+    def query(self, question):
+        self.queries.append(question)
+        return {"status": "success", "served_by": self.replica_id}
+
+    def query_stream(self, question):
+        def chunks():
+            for i, ch in enumerate(self.answer):
+                if (self.fail_stream_after is not None
+                        and i >= self.fail_stream_after):
+                    raise OSError("stream died")
+                yield ch
+        return f"{self.replica_id}-q", "tiny", chunks()
+
+
+def _registry(*reps, **kw):
+    reg = ReplicaRegistry(**kw)
+    for r in reps:
+        reg.add(r)
+    reg.refresh()
+    return reg
+
+
+def _cand(rid, busy=0, total=4, qtok=0, inflight=0):
+    return Candidate(rid, None,
+                     ReplicaStats(busy_slots=busy, total_slots=total,
+                                  queue_tokens=qtok), inflight)
+
+
+# ---------------------------------------------------------------------------
+# Routing policies
+# ---------------------------------------------------------------------------
+
+
+def test_round_robin_rotates():
+    pol = RoundRobinPolicy()
+    cands = [_cand("a"), _cand("b"), _cand("c")]
+    firsts = [pol.rank(list(cands), b"x")[0].replica_id for _ in range(4)]
+    assert firsts == ["a", "b", "c", "a"]
+
+
+def test_least_loaded_orders_by_score():
+    pol = LeastLoadedPolicy()
+    ranked = pol.rank([_cand("a", qtok=100), _cand("b", busy=4), _cand("c")],
+                      b"")
+    assert [c.replica_id for c in ranked] == ["c", "b", "a"]
+
+
+def test_affinity_is_deterministic_and_remap_stable():
+    pol = PrefixAffinityPolicy()
+    cands = [_cand(r) for r in ("a", "b", "c")]
+    digests = [hashlib.sha256(bytes([i])).digest() for i in range(24)]
+    winners = {d: pol.rank(list(cands), d)[0].replica_id for d in digests}
+    assert all(pol.rank(list(cands), d)[0].replica_id == winners[d]
+               for d in digests)
+    assert len(set(winners.values())) > 1   # keys spread over the fleet
+    # Consistent hashing: dropping one replica only remaps its own keys.
+    subset = [c for c in cands if c.replica_id != "c"]
+    for d in digests:
+        if winners[d] != "c":
+            assert pol.rank(list(subset), d)[0].replica_id == winners[d]
+
+
+def test_affinity_saturated_winner_spills_but_stays_preferred():
+    pol = PrefixAffinityPolicy()
+    digest = b""
+    for i in range(64):
+        digest = hashlib.sha256(bytes([i])).digest()
+        if pol.rank([_cand("a"), _cand("b")], digest)[0].replica_id == "a":
+            break
+    sat = [_cand("a", busy=4, total=4, qtok=50), _cand("b")]
+    assert pol.rank(sat, digest)[0].replica_id == "b"   # spilled
+    assert pol.preferred(sat, digest) == "a"            # accounting target
+
+
+# ---------------------------------------------------------------------------
+# Registry
+# ---------------------------------------------------------------------------
+
+
+def test_registry_probe_failure_feeds_breaker():
+    good, bad = ScriptedReplica("good"), ScriptedReplica("bad")
+    reg = _registry(good, bad, breaker_failures=2, breaker_cooldown_s=60.0)
+    assert {c.replica_id for c in reg.candidates()} == {"good", "bad"}
+    bad.ready = False
+    reg.refresh()
+    assert {c.replica_id for c in reg.candidates()} == {"good"}
+    reg.refresh()                           # second failure trips the breaker
+    snap = reg.snapshot()["bad"]
+    assert snap["ready"] is False and snap["breaker_state"] == "open"
+
+
+def test_registry_contains_probe_exceptions():
+    class Exploding(Replica):
+        replica_id = "boom"
+        supports_tokens = True
+
+        def readyz(self):
+            raise OSError("connection refused")
+
+    reg = _registry(Exploding())
+    assert reg.candidates() == []
+    assert "probe failed" in reg.snapshot()["boom"]["reason"]
+
+
+def test_registry_inflight_and_failure_accounting():
+    reg = _registry(ScriptedReplica("a"))
+    reg.note_dispatch("a")
+    reg.note_dispatch("a")
+    assert reg.snapshot()["a"]["inflight"] == 2
+    reg.note_done("a", ok=True)
+    reg.note_done("a", ok=False)
+    snap = reg.snapshot()["a"]
+    assert snap["inflight"] == 0 and snap["failures"] == 1
+
+
+def test_mark_unready_takes_effect_before_next_probe():
+    reg = _registry(ScriptedReplica("a"), ScriptedReplica("b"))
+    reg.mark_unready("a", "observed dead")
+    assert [c.replica_id for c in reg.candidates()] == ["b"]
+
+
+# ---------------------------------------------------------------------------
+# Router: dispatch, failover, hedging (scripted replicas)
+# ---------------------------------------------------------------------------
+
+
+def test_submit_streams_through_replica():
+    a = ScriptedReplica("a")
+    reg = _registry(a)
+    router = FleetRouter(reg, policy="round_robin")
+    h = router.submit([5], SamplingParams(max_tokens=4))
+    toks = list(h.stream(timeout=10))
+    res = h.result(timeout=10)
+    assert toks == [6, 7, 8, 9] == res.token_ids
+    assert res.finish_reason == "length"
+    assert _wait(lambda: router.counters()["completed"] == 1)
+    assert _wait(lambda: reg.snapshot()["a"]["inflight"] == 0)
+    assert router.counters()["dispatches"] == 1
+
+
+def test_empty_fleet_sheds():
+    router = FleetRouter(ReplicaRegistry())
+    with pytest.raises(OverloadedError):
+        router.submit([1], SamplingParams(max_tokens=2))
+    assert router.counters()["sheds"] == 1
+
+
+def test_midstream_failover_replays_remainder_exactly():
+    a = ScriptedReplica("a", fail_after=3)
+    b = ScriptedReplica("b")
+    reg = _registry(a, b)
+    router = FleetRouter(reg, policy="round_robin", max_failovers=2)
+    h = router.submit([5], SamplingParams(max_tokens=8))
+    toks = list(h.stream(timeout=10))
+    res = h.result(timeout=10)
+    assert res.finish_reason == "length"
+    assert toks == res.token_ids == [6, 7, 8, 9, 10, 11, 12, 13]
+    # Replay contract: prompt + emitted folded in, budget trimmed, fresh
+    # attempt id, dead replica excluded.
+    prompt, sampling, rid = b.calls[0]
+    assert prompt == [5, 6, 7, 8]
+    assert sampling.max_tokens == 5
+    assert rid.endswith("-a1")
+    assert _wait(lambda: router.counters()["failovers"] == 1)
+    c = router.counters()
+    assert c["completed"] == 1 and c["failed"] == 0
+    assert reg.snapshot()["a"]["ready"] is False
+
+
+def test_failover_budget_exhausted_fails_with_partial_tokens():
+    a = ScriptedReplica("a", fail_after=2)
+    b = ScriptedReplica("b", fail_after=2)
+    router = FleetRouter(_registry(a, b), policy="round_robin",
+                         max_failovers=1)
+    h = router.submit([5], SamplingParams(max_tokens=8))
+    toks = list(h.stream(timeout=10))
+    res = h.result(timeout=10)
+    assert res.finish_reason == "error"
+    assert "failover budget exhausted" in res.error
+    assert toks == [6, 7, 8, 9]           # both incarnations' tokens, no dup
+    assert router.counters()["failed"] == 1
+
+
+def test_death_after_full_budget_completes_trimmed():
+    a = ScriptedReplica("a", fail_after=4)   # whole budget, then dies
+    b = ScriptedReplica("b")
+    router = FleetRouter(_registry(a, b), policy="round_robin")
+    h = router.submit([5], SamplingParams(max_tokens=4))
+    res = h.result(timeout=10)
+    assert res.finish_reason == "length" and res.token_ids == [6, 7, 8, 9]
+    assert b.calls == []                  # nothing left to regenerate
+
+
+def test_hedge_fires_and_second_replica_wins():
+    a = ScriptedReplica("a", stall=True)
+    b = ScriptedReplica("b")
+    reg = _registry(a, b)
+    router = FleetRouter(reg, policy="round_robin",
+                         hedge=HedgeConfig(enabled=True, fixed_delay_s=0.05))
+    h = router.submit([5], SamplingParams(max_tokens=4))
+    toks = list(h.stream(timeout=10))
+    res = h.result(timeout=10)
+    assert toks == [6, 7, 8, 9] and res.finish_reason == "length"
+    c = router.counters()
+    assert c["hedges_fired"] == 1 and c["hedges_won"] == 1
+    assert b.calls[0][2].endswith("-h")
+    assert _wait(lambda: a.cancelled)     # loser cancelled
+    assert _wait(lambda: reg.snapshot()["a"]["inflight"] == 0
+                 and reg.snapshot()["b"]["inflight"] == 0)
+
+
+def test_fast_primary_suppresses_hedge():
+    a, b = ScriptedReplica("a"), ScriptedReplica("b")
+    router = FleetRouter(_registry(a, b), policy="round_robin",
+                         hedge=HedgeConfig(enabled=True, fixed_delay_s=0.5))
+    res = router.submit([5], SamplingParams(max_tokens=3)).result(timeout=10)
+    assert res.token_ids == [6, 7, 8]
+    assert router.counters()["hedges_fired"] == 0
+    assert b.calls == []
+
+
+def test_hedge_delay_tracks_ttft_ema():
+    router = FleetRouter(_registry(ScriptedReplica("a")),
+                         hedge=HedgeConfig(enabled=True, min_delay_s=0.05,
+                                           cold_delay_s=0.4))
+    assert router.hedge_delay_s() == 0.4          # no TTFT sample yet
+    for _ in range(8):
+        router._note_ttft(0.1)
+    delay = router.hedge_delay_s()
+    assert delay == pytest.approx(0.1 + 3.0 * router._ttft_dev)
+    assert delay >= 0.05
+    router.hedge.fixed_delay_s = 0.123
+    assert router.hedge_delay_s() == 0.123
+
+
+def test_text_query_routes_and_sheds_when_empty():
+    a = ScriptedQueryReplica("a")
+    router = FleetRouter(_registry(a), policy="least_loaded")
+    assert router.query("why")["served_by"] == "a"
+    a.ready = False
+    router.registry.refresh()
+    with pytest.raises(OverloadedError):
+        router.query("again")
+
+
+def test_text_stream_failover_suppresses_delivered_prefix():
+    a = ScriptedQueryReplica("a", fail_stream_after=4)
+    b = ScriptedQueryReplica("b")
+    router = FleetRouter(_registry(a, b), policy="round_robin",
+                         max_failovers=2)
+    _rid, _model, deltas = router.query_stream("q")
+    assert "".join(deltas) == "hello world"       # no dup, no gap
+    assert _wait(lambda: router.counters()["failovers"] == 1)
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: real in-process fleets
+# ---------------------------------------------------------------------------
+
+
+def _local_fleet(params, n=2):
+    reps = []
+    for i in range(n):
+        eng = InferenceEngine(CFG, params, EngineConfig(**ECFG), eos_id=-1)
+        reps.append(LocalReplica(f"r{i}", service=EngineService(eng)))
+    reg = ReplicaRegistry()
+    for r in reps:
+        reg.add(r)
+    reg.refresh()
+    return reg, reps
+
+
+def _prefix_workload(params, policy):
+    """3 prefix groups x 5 rounds, submitted sequentially so each round can
+    hit the pages the previous one published.  3 groups over 2 replicas
+    breaks round-robin's periodicity, so RR smears every group across both
+    caches while affinity pins each group to one."""
+    reg, reps = _local_fleet(params)
+    router = FleetRouter(reg, policy=policy, affinity_prefix_tokens=16)
+    rng = np.random.default_rng(21)
+    groups = [list(rng.integers(3, 300, size=16)) for _ in range(3)]
+    try:
+        for _ in range(5):
+            for g in groups:
+                p = g + list(rng.integers(3, 300, size=3))
+                res = router.submit(
+                    p, SamplingParams(max_tokens=4)).result(timeout=60)
+                assert res.finish_reason == "length"
+            reg.refresh()
+        hits = misses = 0
+        for r in reps:
+            s = r.stats()
+            hits += s.prefix_hits
+            misses += s.prefix_misses
+    finally:
+        for r in reps:
+            r.close()
+    return hits / max(1, hits + misses), router.counters()
+
+
+@pytest.mark.slow  # boots 4 live engines; covered by make chaos-fleet
+def test_affinity_beats_round_robin_on_prefix_hit_rate(params):
+    affinity_rate, affinity_counters = _prefix_workload(params, "affinity")
+    rr_rate, _ = _prefix_workload(params, "round_robin")
+    assert affinity_rate > rr_rate, (affinity_rate, rr_rate)
+    assert affinity_counters["affinity_hits"] == 15   # every dispatch on home
+
+
+@pytest.mark.chaos
+@pytest.mark.slow  # 32 streams + greedy oracle; covered by make chaos-fleet
+def test_chaos_replica_kill_midstream_loses_no_tokens(params):
+    """The ISSUE acceptance gate: 2-replica fleet, 32 concurrent streaming
+    requests, one replica killed while actively decoding — every request
+    completes on the survivor with zero duplicated and zero lost tokens,
+    and the failover/affinity gauges reflect it."""
+    reg, reps = _local_fleet(params)
+    router = FleetRouter(reg, policy="affinity", max_failovers=2)
+    rng = np.random.default_rng(33)
+    n_tok = 16
+    prompts = [list(rng.integers(3, 300, size=4)) for _ in range(32)]
+    try:
+        handles = [router.submit(p, SamplingParams(max_tokens=n_tok))
+                   for p in prompts]
+        victim = reps[0]
+        assert _wait(lambda: victim.service.engine.active_slots > 0,
+                     timeout=60), "victim never received work"
+        victim.kill()
+
+        streams = []
+        for h in handles:
+            toks = list(h.stream(timeout=120))
+            res = h.result(timeout=120)
+            assert res.finish_reason == "length", (res.finish_reason,
+                                                   res.error)
+            assert toks == res.token_ids, "stream/result token mismatch"
+            streams.append(toks)
+        for p, toks in zip(prompts, streams):
+            assert toks == _naive_greedy(params, p, n_tok), \
+                "failover duplicated or lost tokens"
+
+        c = router.counters()
+        assert c["completed"] == 32 and c["failed"] == 0
+        assert c["failovers"] >= 1
+        assert c["affinity_hits"] + c["affinity_spills"] == 32
+        snap = reg.snapshot()
+        assert snap["r0"]["ready"] is False
+        assert snap["r0"]["failures"] >= 1
+    finally:
+        for r in reps:
+            r.close()
+
+
+# ---------------------------------------------------------------------------
+# HTTP fleet: stats route, router role, exporter gauges, SSE failover
+# ---------------------------------------------------------------------------
+
+
+def _boot_http_replica(params, max_tokens=24):
+    tok = ByteTokenizer()
+    engine = InferenceEngine(
+        CFG, params,
+        EngineConfig(max_slots=2, num_blocks=512, block_size=16,
+                     max_blocks_per_seq=128, prefill_buckets=(128, 512, 2048),
+                     decode_steps_per_iter=4),
+        tokenizer=tok)
+    backend = LocalEngineBackend(engine, tok)
+    analysis = AnalysisEngine(backend, llm_cfg=LLMConfig(max_tokens=max_tokens))
+    srv = MonitorServer(config=Config(), analysis=analysis, port=0)
+    srv.start()
+    return srv, backend
+
+
+def _boot_http_fleet(params, max_tokens=24):
+    reps = [_boot_http_replica(params, max_tokens) for _ in range(2)]
+    cfg = Config()
+    cfg.server.port = 0
+    cfg.fleet.replicas = [f"http://127.0.0.1:{srv.port}" for srv, _ in reps]
+    cfg.fleet.probe_interval_s = 0.5
+    router_srv = build_router_server(cfg)
+    router_srv.start()
+    return router_srv, reps
+
+
+def _shutdown_http_fleet(router_srv, reps):
+    router_srv.analysis.close()
+    router_srv.stop()
+    for srv, backend in reps:
+        srv.stop()
+        try:
+            backend.service.stop(timeout=5.0)
+        except Exception:
+            pass
+
+
+def _get_json(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}",
+                                timeout=30) as r:
+        return json.loads(r.read())
+
+
+@pytest.fixture(scope="module")
+def http_fleet(params):
+    router_srv, reps = _boot_http_fleet(params)
+    yield router_srv, reps
+    _shutdown_http_fleet(router_srv, reps)
+
+
+@pytest.mark.slow  # shares the live 2-engine HTTP fleet; make chaos-fleet
+def test_stats_route_reports_engine_load(http_fleet):
+    _router_srv, reps = http_fleet
+    stats = _get_json(reps[0][0].port, "/api/v1/stats")
+    eng = stats["engine"]
+    assert eng["total_slots"] == 2
+    assert eng["prefix_cache"] is not None
+    for key in ("queue_depth", "queue_tokens", "busy_slots"):
+        assert key in eng
+
+
+@pytest.mark.slow  # shares the live 2-engine HTTP fleet; make chaos-fleet
+def test_router_role_serves_replica_api(http_fleet):
+    router_srv, _reps = http_fleet
+    rstats = _get_json(router_srv.port, "/api/v1/stats")
+    assert set(rstats["fleet"]["replicas"]) == {"replica-0", "replica-1"}
+    assert "dispatches" in rstats["fleet"]["counters"]
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{router_srv.port}/api/v1/query",
+        data=json.dumps({"question": "why is my pod crashlooping"}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        body = json.loads(r.read())
+    assert body["status"] == "success"
+    assert body["result"].get("answer")
+
+    health = _get_json(router_srv.port, "/health")
+    assert "fleet" in health
+
+
+@pytest.mark.slow  # shares the live 2-engine HTTP fleet; make chaos-fleet
+def test_router_metrics_export_fleet_gauges(http_fleet):
+    router_srv, _reps = http_fleet
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{router_srv.port}/metrics", timeout=30) as r:
+        text = r.read().decode()
+    for gauge in ("k8s_llm_monitor_fleet_replica_ready",
+                  "k8s_llm_monitor_fleet_replica_inflight",
+                  "k8s_llm_monitor_fleet_affinity_hits_total",
+                  "k8s_llm_monitor_fleet_hedges_fired_total",
+                  "k8s_llm_monitor_fleet_failovers_total",
+                  "k8s_llm_monitor_fleet_hedge_delay_seconds"):
+        assert gauge in text, gauge
+    assert 'replica="replica-0"' in text and 'replica="replica-1"' in text
+
+
+@pytest.mark.chaos
+@pytest.mark.slow  # boots its own 2-replica HTTP fleet; make chaos-fleet
+def test_http_stream_fails_over_when_replica_dies(params):
+    router_srv, reps = _boot_http_fleet(params, max_tokens=96)
+    router = router_srv.analysis.router
+    killed = {}
+
+    def _assassin():
+        # Kill the serving replica the moment its engine starts decoding —
+        # waiting for client-side SSE events loses the race on a tiny model
+        # (the whole answer can be generated and buffered before the first
+        # event reaches the client).
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            for i, (_srv, backend) in enumerate(reps):
+                if backend.service.engine.active_slots > 0:
+                    backend.service.stop(timeout=5.0)
+                    killed["idx"] = i
+                    return
+            time.sleep(0.002)
+
+    assassin = threading.Thread(target=_assassin, daemon=True)
+    assassin.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{router_srv.port}/api/v1/query",
+            data=json.dumps({"question": "tell me everything",
+                             "stream": True}).encode(),
+            headers={"Content-Type": "application/json"})
+        deltas, done = [], None
+        with urllib.request.urlopen(req, timeout=120) as r:
+            for raw in r:
+                line = raw.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                ev = json.loads(line[6:])
+                if ev.get("done"):
+                    done = ev
+                elif ev.get("delta"):
+                    deltas.append(ev["delta"])
+        assassin.join(timeout=60)
+        assert killed, "no replica ever started decoding"
+        assert done is not None, "stream never completed after replica death"
+        assert deltas
+        assert _wait(lambda: router.counters()["failovers"] >= 1)
+        assert router.counters()["failed"] == 0
+    finally:
+        _shutdown_http_fleet(router_srv, reps)

@@ -214,6 +214,68 @@ def test_fault_point_hinted_receivers_only():
     assert len(lint(src, "fault-point")) == 1
 
 
+# -- astlint: raw-lock -------------------------------------------------------
+
+
+def test_raw_lock_flags_threading_lock_and_rlock():
+    src = """
+    import threading
+
+    class S:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._reentrant = threading.RLock()
+    """
+    assert len(lint(src, "raw-lock")) == 2
+
+
+def test_raw_lock_flags_from_imports_and_aliases():
+    src = """
+    from threading import Lock, RLock as RL
+
+    a = Lock()
+    b = RL()
+    """
+    assert len(lint(src, "raw-lock")) == 2
+
+
+def test_raw_lock_clean_for_make_lock_and_other_primitives():
+    src = """
+    import threading
+
+    from k8s_llm_monitor_tpu.devtools.lockcheck import make_lock
+
+    class S:
+        def __init__(self):
+            self._lock = make_lock("s")
+            self._stop = threading.Event()
+            self._cv = threading.Condition(self._lock)
+    """
+    assert lint(src, "raw-lock") == []
+
+
+def test_raw_lock_exempts_the_lockcheck_factory_itself():
+    src = textwrap.dedent("""
+    import threading
+
+    def make_lock(name):
+        return threading.Lock()
+    """)
+    findings = astlint.lint_source(src, path="devtools/lockcheck.py")
+    assert [f for f in findings if f.rule == "raw-lock"] == []
+    findings = astlint.lint_source(src, path="somewhere/else.py")
+    assert len([f for f in findings if f.rule == "raw-lock"]) == 1
+
+
+def test_raw_lock_line_suppression():
+    src = """
+    import threading
+
+    _probe = threading.Lock()  # graftcheck: disable=raw-lock -- boot probe
+    """
+    assert lint(src, "raw-lock") == []
+
+
 # -- astlint: suppressions + parse errors ------------------------------------
 
 

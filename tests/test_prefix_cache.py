@@ -4,6 +4,8 @@ is exact (greedy outputs unchanged), and cache eviction relieves page
 pressure before preemption.
 """
 
+import threading
+
 import numpy as np
 import pytest
 
@@ -111,6 +113,39 @@ def test_prefix_cache_never_shares_whole_prompt():
     shared, toks = pc.lookup(list(prompt))
     assert toks == 4 and len(shared) == 1          # only the first block
     a.free(shared)
+
+
+def test_eviction_with_live_follower_does_not_free_shared_pages():
+    """LRU eviction while a follower holds lookup refs on the entry's
+    blocks must drop only the cache's refs — the follower's pages stay
+    allocated (and intact) until the follower releases them."""
+    a = BlockAllocator(num_blocks=32, block_size=4)
+    pc = PrefixCache(a, max_entries=2)
+    prompt = list(range(100, 109))                 # 9 tokens -> 2 full blocks
+    blocks = a.alloc(10)
+    pc.register(prompt, blocks)
+    a.free(blocks)                                 # slot done; cache holds on
+
+    shared, toks = pc.lookup(list(prompt))         # follower attaches
+    assert toks == 8 and len(shared) == 2
+
+    # Displace the entry while the follower is still attached.
+    p2 = [7] * 9
+    b2 = a.alloc(10)
+    pc.register(p2, b2)
+    a.free(b2)
+    assert pc.evictions >= 1
+
+    # Cache refs dropped, follower refs intact: exactly one holder each,
+    # and the pages are NOT back in the free pool.
+    assert a.ref_count(shared[0]) == 1
+    assert a.ref_count(shared[1]) == 1
+    assert a.free_blocks == 27     # 31 usable - 2 follower - 2 new entry
+    follower = list(shared)
+    a.free(follower)
+    assert a.free_blocks == 29
+    pc.clear()
+    assert a.free_blocks == 31
 
 
 def test_prefix_cache_eviction_returns_blocks():
@@ -368,3 +403,40 @@ def test_defer_budget_bounds_round_scan(params):
         res = eng.poll(f"d{i}")
         assert res is not None
         assert res.token_ids == _naive_greedy(params, p, 3)
+
+
+def test_concurrent_cold_admission_publishes_once(params):
+    """Two same-prefix requests racing through the thread-safe service
+    submit path onto a COLD cache (the fleet router's affinity shape):
+    whatever round each lands in, the prefix is published exactly once,
+    outputs stay greedy-exact, and every page comes back."""
+    from k8s_llm_monitor_tpu.serving.service import EngineService
+
+    eng = _engine(params, max_slots=4, max_prefills_per_step=4)
+    svc = EngineService(eng)
+    rng = np.random.default_rng(17)
+    prefix = list(rng.integers(3, 300, size=24))   # 3 full blocks at bs=8
+    prompts = [prefix + list(rng.integers(3, 300, size=4)) for _ in range(2)]
+    handles = [None, None]
+    barrier = threading.Barrier(2)
+
+    def submit(i):
+        barrier.wait()
+        handles[i] = svc.submit(list(prompts[i]),
+                                SamplingParams(max_tokens=5),
+                                request_id=f"race{i}")
+
+    threads = [threading.Thread(target=submit, args=(i,)) for i in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    results = [h.result(timeout=60) for h in handles]
+    svc.stop(timeout=10.0)
+    for p, r in zip(prompts, results):
+        assert r.finish_reason == "length"
+        assert r.token_ids == _naive_greedy(params, p, 5)
+    assert eng.prefix_cache.misses <= 1            # no double-publish
+    assert eng.prefix_cache.hits >= 1              # the loser reused it
+    eng.prefix_cache.clear()
+    assert eng.allocator.free_blocks == 63         # nothing leaked
