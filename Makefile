@@ -6,9 +6,9 @@ TEST_ENV = env PYTHONPATH= JAX_PLATFORMS=cpu
 SHELL := /bin/bash    # tier1 uses pipefail/PIPESTATUS
 
 .PHONY: run run-agent run-scheduler demo test test-fast tier1 chaos \
-        chaos-lifecycle chaos-fleet bench bench-decode bench-fleet dryrun \
-        smoke preflight deploy-agent docker docker-agent docker-scheduler \
-        lint lint-trace clean
+        chaos-lifecycle chaos-fleet diagnose-e2e bench bench-decode \
+        bench-fleet dryrun smoke preflight deploy-agent docker docker-agent \
+        docker-scheduler lint lint-trace clean
 
 run:
 	$(PY) -m k8s_llm_monitor_tpu.cmd.server --cluster fake --port 8081
@@ -56,6 +56,14 @@ chaos-lifecycle:
 chaos-fleet:
 	$(TEST_ENV) K8SLLM_LOCKCHECK=1 \
 	  $(PY) -m pytest tests/test_fleet.py -q -p no:cacheprovider
+
+# Diagnosis acceptance (docs/diagnosis.md): grammar compiler units, the
+# constrained-sampling fuzz (every sample parses), and the synthetic
+# crash-loop burst → verdict e2e — with lock discipline checked.
+diagnose-e2e:
+	$(TEST_ENV) K8SLLM_LOCKCHECK=1 \
+	  $(PY) -m pytest tests/test_grammar.py tests/test_diagnosis.py -q \
+	  -p no:cacheprovider
 
 bench:
 	$(PY) bench.py

@@ -1029,6 +1029,79 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001 — extras never fail the bench
         log(f"long-context verify leg skipped: {exc}")
 
+    # --- constrained-decode leg: grammar FSM masking A/B ----------------
+    # The diagnosis engine's verdict grammar (diagnosis/grammar.py) masks
+    # logits against a token FSM inside the same fused decode scan the
+    # free path runs.  This leg measures the per-token decode tax of that
+    # mask on one engine serving both kinds of lanes, checks the 100%
+    # schema-validity property on everything sampled, and asserts the
+    # overhead stays under 10% — the budget that makes constrained
+    # verdicts the default for /api/v1/analyze.
+    free_ms_tok = constrained_ms_tok = constrained_penalty = None
+    try:
+        from k8s_llm_monitor_tpu.diagnosis.grammar import (
+            parse_verdict,
+            verdict_fsm,
+        )
+
+        if cfg.vocab_size < 259:
+            raise ValueError(
+                f"vocab {cfg.vocab_size} < byte-tokenizer vocab 259")
+        g_n = int(os.environ.get("BENCH_CONSTRAINED_CONCURRENCY", "8"))
+        g_len, g_gen = 64, 256
+        fsm = verdict_fsm(eos_id=2)
+        g_cap = g_len + max(g_gen, fsm.max_len) + 16
+        g_ecfg = EngineConfig(
+            max_slots=g_n,
+            num_blocks=g_n * ((g_cap + 15) // 16) + 16,
+            block_size=16,
+            max_blocks_per_seq=(g_cap + 15) // 16,
+            prefill_buckets=(g_len,),
+            max_prefills_per_step=g_n,
+            decode_steps_per_iter=8,
+        )
+        ge = InferenceEngine(cfg, params, g_ecfg, eos_id=2)
+        ge.set_grammar(fsm)
+
+        def g_prompt() -> list[int]:
+            return [int(t) for t in
+                    rng.integers(4, min(cfg.vocab_size, 259) - 4, size=g_len)]
+
+        g_free = SamplingParams(max_tokens=g_gen, temperature=0.7)
+        g_con = SamplingParams(max_tokens=1, temperature=0.7,
+                               constrained=True)
+        # Warm both program families (free and constrained decode).
+        ge.generate([g_prompt() for _ in range(g_n)],
+                    SamplingParams(max_tokens=8, temperature=0.7))
+        ge.generate([g_prompt() for _ in range(g_n)], g_con)
+
+        def per_token_ms(results) -> float:
+            rates = [(r.latency_s - r.ttft_s) * 1e3 / (len(r.token_ids) - 1)
+                     for r in results if len(r.token_ids) > 1]
+            return float(np.median(rates))
+
+        free_res = ge.generate([g_prompt() for _ in range(g_n)], g_free)
+        con_res = ge.generate([g_prompt() for _ in range(g_n)], g_con)
+        assert all(r.finish_reason != "error" for r in free_res + con_res)
+        for r in con_res:  # the 100% schema-validity property, re-proven
+            parse_verdict("".join(chr(t - 3) for t in r.token_ids
+                                  if 3 <= t < 259))
+        free_ms_tok = per_token_ms(free_res)
+        constrained_ms_tok = per_token_ms(con_res)
+        constrained_penalty = (constrained_ms_tok - free_ms_tok) \
+            / free_ms_tok
+        log(f"constrained decode: {constrained_ms_tok:.2f} ms/tok vs "
+            f"free {free_ms_tok:.2f} ms/tok "
+            f"({constrained_penalty * 100:+.1f}% tok/s penalty)")
+        assert constrained_penalty < 0.10, (
+            f"constrained decode tax {constrained_penalty * 100:.1f}% "
+            f"exceeds the 10% budget")
+        del ge
+    except AssertionError:
+        raise  # a blown overhead budget IS a bench failure
+    except Exception as exc:  # noqa: BLE001 — extras never fail the bench
+        log(f"constrained-decode leg skipped: {exc}")
+
     # BASELINE config #3: encoder embedding throughput (BGE-large geometry
     # on TPU, tiny on CPU smoke runs), via the anomaly detector's batch path.
     embed_docs_per_s = 0.0
@@ -1312,6 +1385,10 @@ def main() -> None:
     if vk_tok_s is not None and vg_tok_s is not None:
         extras["verify_kernel_longctx_tok_s"] = round(vk_tok_s, 1)
         extras["verify_gather_longctx_tok_s"] = round(vg_tok_s, 1)
+    if constrained_penalty is not None:
+        extras["constrained_decode_ms_per_tok"] = round(constrained_ms_tok, 3)
+        extras["free_decode_ms_per_tok"] = round(free_ms_tok, 3)
+        extras["constrained_decode_penalty"] = round(constrained_penalty, 3)
     if restart_to_token_ms is not None:
         extras["warm_restart_to_token_ms"] = round(restart_to_token_ms, 1)
         extras["warm_restart_replayed"] = restart_replayed

@@ -34,6 +34,13 @@ def _graceful_shutdown(srv, grace_s: float, log: logging.Logger) -> None:
     stops routing new traffic while inflight generations finish — the
     manifest's preStop sleep covers the propagation delay.
     """
+    watcher = getattr(srv, "diagnosis_watcher", None)
+    if watcher is not None:
+        watcher.stop()
+        log.info("diagnosis watcher stopped")
+    if srv.diagnosis is not None:
+        srv.diagnosis.stop()
+        log.info("diagnosis pipeline stopped")
     sup = srv.engine_supervisor()
     if sup is not None:
         drained = sup.shutdown(grace_s=grace_s)
@@ -172,6 +179,21 @@ def main(argv: list[str] | None = None) -> int:
             "metrics manager started (interval %ds)", config.metrics.collect_interval
         )
 
+    # Standing watcher→LLM diagnosis loop: the resource watcher feeds the
+    # pipeline's EventHandler; the pipeline's worker thread (started with
+    # the HTTP server) turns event bursts into constrained root-cause
+    # verdicts behind GET /api/v1/diagnoses.
+    srv.diagnosis_watcher = None
+    if srv.diagnosis is not None and srv.client is not None:
+        from k8s_llm_monitor_tpu.monitor.watcher import Watcher
+
+        srv.diagnosis_watcher = Watcher(
+            srv.client, srv.diagnosis.handler,
+            namespaces=config.k8s.watch_namespaces)
+        srv.diagnosis_watcher.start()
+        log.info("diagnosis watcher started (burst threshold %d in %.0fs)",
+                 config.diagnosis.burst_threshold, config.diagnosis.window_s)
+
     # SIGTERM (kubelet) / SIGINT: flip readiness to 503, drain inflight
     # generations within the grace window, seal the request journal, exit.
     # The work runs on a helper thread: httpd.shutdown() deadlocks when
@@ -200,6 +222,10 @@ def main(argv: list[str] | None = None) -> int:
         srv.serve_forever()
     finally:
         if not shutdown_started.is_set():
+            if srv.diagnosis_watcher is not None:
+                srv.diagnosis_watcher.stop()
+            if srv.diagnosis is not None:
+                srv.diagnosis.stop()
             sup = srv.engine_supervisor()
             if sup is not None:
                 sup.shutdown(grace_s=0.0)

@@ -414,9 +414,101 @@ class RawLockRule(Rule):
                     f"K8SLLM_LOCKCHECK=1 runs can audit it")
 
 
+class UnconstrainedParseRule(Rule):
+    """Model output must be parsed through ``diagnosis.grammar``.
+
+    A ``json.loads`` on LLM-generated text is a latent 500: free-running
+    decode produces almost-JSON often enough to pass review and rarely
+    enough to page at 3am.  The sanctioned path is FSM-constrained decode
+    plus ``diagnosis.grammar.parse_verdict`` (which validates against the
+    same DFA before parsing) — ``diagnosis/grammar.py`` is therefore the
+    one file allowed to call ``json.loads`` on model text.
+
+    Heuristics: a ``json.loads`` call is treated as parsing model output
+    when it sits inside a class that looks like an LLM provider adapter
+    (name ends with ``Backend`` *and* defines ``generate`` — which keeps
+    ``KubeRestBackend`` out), or when its argument's name carries a
+    model-output marker (``answer``, ``verdict``, ``completion``,
+    ``generated`` …).
+    Request-body parsing (``_read_json`` in the HTTP server) matches
+    neither and stays unflagged.  Protocol-level parses inside a Backend
+    (e.g. an OpenAI-compat HTTP envelope) suppress with
+    ``# graftcheck: disable=unconstrained-model-parse -- reason``.
+    """
+
+    name = "unconstrained-model-parse"
+    description = "json.loads of model output outside diagnosis/grammar.py"
+
+    _MARKERS = ("answer", "verdict", "completion", "generated",
+                "generation", "model_output", "llm_text")
+
+    @staticmethod
+    def _loads_names(tree: ast.Module) -> set[str]:
+        """Local names bound to json.loads via from-imports."""
+        names: set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ImportFrom) and node.module == "json":
+                for alias in node.names:
+                    if alias.name == "loads":
+                        names.add(alias.asname or alias.name)
+        return names
+
+    def _is_loads(self, call: ast.Call, bare: set[str]) -> bool:
+        dn = dotted_name(call.func)
+        return dn == "json.loads" or dn in bare
+
+    def _arg_marker(self, call: ast.Call) -> str:
+        if not call.args:
+            return ""
+        # Strip decode()/strip() chains: json.loads(raw_answer.strip()).
+        arg = call.args[0]
+        while isinstance(arg, ast.Call):
+            arg = arg.func
+        label = dotted_name(arg).lower()
+        for marker in self._MARKERS:
+            if marker in label:
+                return marker
+        return ""
+
+    def check(self, tree: ast.Module, path: str) -> Iterator[Finding]:
+        if path.replace("\\", "/").endswith("diagnosis/grammar.py"):
+            return  # the sanctioned parser
+        bare = self._loads_names(tree)
+        in_backend: set[int] = set()
+        for cls in ast.walk(tree):
+            if not (isinstance(cls, ast.ClassDef)
+                    and cls.name.endswith("Backend")):
+                continue
+            if not any(isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+                       and m.name == "generate" for m in cls.body):
+                continue  # e.g. KubeRestBackend: no LLM here
+            for sub in ast.walk(cls):
+                in_backend.add(id(sub))
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and self._is_loads(node, bare)):
+                continue
+            marker = self._arg_marker(node)
+            if id(node) in in_backend:
+                yield self.finding(
+                    path, node,
+                    "json.loads inside an LLM backend class parses model "
+                    "output unconstrained; use FSM-constrained decode + "
+                    "diagnosis.grammar.parse_verdict, or suppress for "
+                    "protocol-envelope parses")
+            elif marker:
+                yield self.finding(
+                    path, node,
+                    f"json.loads of '{marker}'-named value looks like "
+                    f"free-running model output; route it through "
+                    f"diagnosis.grammar.parse_verdict so malformed JSON "
+                    f"cannot reach callers")
+
+
 def default_rules() -> list[Rule]:
     return [JitHostReadRule(), LockBlockingCallRule(), BareExceptRule(),
-            MutableDefaultRule(), FaultPointRule(), RawLockRule()]
+            MutableDefaultRule(), FaultPointRule(), RawLockRule(),
+            UnconstrainedParseRule()]
 
 
 ALL_RULE_NAMES = tuple(r.name for r in default_rules())

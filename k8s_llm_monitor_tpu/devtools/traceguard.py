@@ -68,11 +68,32 @@ def _tiny_cfg(fused: bool):
                        num_kv_heads=2, dtype="float32", rope_theta=10_000.0)
 
 
+def _toy_fsm():
+    """A hand-built 2-state cycling grammar over a 16-token vocab: states
+    1 and 2 allow tokens 3..10 and alternate forever (max_len unbounded, so
+    constrained drives terminate by budget — eos_id -1 matches the guard
+    engines).  Big enough to exercise every constrained program; far
+    smaller than the 259-vocab verdict grammar, which would not fit the
+    tiny guard models."""
+    import numpy as np
+
+    from k8s_llm_monitor_tpu.diagnosis.grammar import TokenFSM
+
+    trans = np.full((3, 16), -1, dtype=np.int32)
+    trans[0, :] = 0
+    trans[1, 3:11] = 2
+    trans[2, 3:11] = 1
+    return TokenFSM.from_table(trans, start=1,
+                               accept=np.array([False, True, True]),
+                               eos_id=-1)
+
+
 def build_engine(decode_path: str = "gather", seed: int = 0):
     """A tiny engine wired for deterministic compile accounting: prefix
     cache off (a second same-prefix prompt would switch admission to the
     chunked program — a *legitimate* new compile the guard must not count),
-    speculation off, two buckets."""
+    speculation off, two buckets.  A toy grammar is installed so the
+    constrained decode/prefill programs join the gated set."""
     import jax
 
     from k8s_llm_monitor_tpu.models import llama
@@ -88,8 +109,10 @@ def build_engine(decode_path: str = "gather", seed: int = 0):
         spec_k=0, prefix_cache_entries=0, sample_topk_cap=8,
     )
     impl = select_decode_impl(cfg=cfg, mode=decode_path)
-    return InferenceEngine(cfg, params, engine_cfg=ec, eos_id=-1,
-                           attn_impl=impl)
+    engine = InferenceEngine(cfg, params, engine_cfg=ec, eos_id=-1,
+                             attn_impl=impl)
+    engine.set_grammar(_toy_fsm())
+    return engine
 
 
 # ---------------------------------------------------------------------------
@@ -99,7 +122,8 @@ def build_engine(decode_path: str = "gather", seed: int = 0):
 def _engine_programs(engine) -> list[Any]:
     progs = [engine._prefill_sample, engine._prefill_greedy,
              engine._prefill_chunk_sample, engine._prefill_chunk_greedy,
-             engine._place_tokens]
+             engine._prefill_sample_fsm, engine._prefill_chunk_sample_fsm,
+             engine._place_tokens, engine._place_fsm]
     if engine._hist_place is not None:
         progs.append(engine._hist_place)
     progs.extend(engine._decode_cache.values())
@@ -226,6 +250,15 @@ def scan_engine_programs(engine) -> dict[str, list[str]]:
         params, tok, ctx, remaining, pages, dec_tables, temp, topk, topp,
         rng, eos))
 
+    if engine._fsm_trans is not None:
+        constrained = engine._decode_program(
+            K, sampled=True, bounded=ec.sample_topk_cap > 0,
+            constrained=True)
+        fstate = jnp.ones((B,), jnp.int32)
+        out["decode_constrained"] = forbidden_ops(jax.make_jaxpr(constrained)(
+            params, tok, fstate, ctx, remaining, pages, dec_tables,
+            engine._fsm_trans, temp, topk, topp, rng, eos))
+
     P = 1
     ptoks = jnp.zeros((P, bucket), jnp.int32)
     plens = jnp.full((P,), bucket, jnp.int32)
@@ -237,6 +270,14 @@ def scan_engine_programs(engine) -> dict[str, list[str]]:
             params, ptoks, plens, pages, ptbl,
             jnp.full((P,), 0.7, jnp.float32), jnp.full((P,), 4, jnp.int32),
             jnp.full((P,), 0.9, jnp.float32), rng))
+    if engine._fsm_trans is not None:
+        out["prefill_constrained"] = forbidden_ops(jax.make_jaxpr(
+            engine._prefill_sample_fsm)(
+                params, ptoks, plens, pages, ptbl,
+                jnp.ones((P,), jnp.int32), engine._fsm_trans,
+                jnp.full((P,), 0.7, jnp.float32),
+                jnp.full((P,), 4, jnp.int32),
+                jnp.full((P,), 0.9, jnp.float32), rng))
     return out
 
 
@@ -254,13 +295,15 @@ class PathReport:
     forbidden: dict[str, list[str]]
     donated_pages_rebound: bool
     donated_tokens_rebound: bool
+    donated_fsm_rebound: bool = True
 
     @property
     def ok(self) -> bool:
         return (self.repeat_compiles == 0
                 and not any(self.forbidden.values())
                 and self.donated_pages_rebound
-                and self.donated_tokens_rebound)
+                and self.donated_tokens_rebound
+                and self.donated_fsm_rebound)
 
     def as_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -268,15 +311,18 @@ class PathReport:
         return d
 
 
-def _drive(engine, prompt_len: int, greedy: bool, tag: int) -> None:
+def _drive(engine, prompt_len: int, greedy: bool, tag: int,
+           constrained: bool = False) -> None:
     """One generation in the first prefill bucket: 4 tokens, distinct
     prompt content per ``tag`` (same shapes, different values — content
     must never matter to the compile count)."""
     from k8s_llm_monitor_tpu.serving.engine import SamplingParams
 
     prompt = [(tag * 7 + i) % 100 + 1 for i in range(prompt_len)]
-    sampling = (SamplingParams(max_tokens=4) if greedy
-                else SamplingParams(max_tokens=4, temperature=0.7, top_k=4))
+    sampling = (SamplingParams(max_tokens=4, constrained=constrained)
+                if greedy
+                else SamplingParams(max_tokens=4, temperature=0.7, top_k=4,
+                                    constrained=constrained))
     res = engine.generate([prompt], sampling)[0]
     assert res.finish_reason in ("eos", "length"), res
 
@@ -287,25 +333,29 @@ def check_path(decode_path: str) -> PathReport:
     def warm():
         _drive(engine, prompt_len=12, greedy=True, tag=1)
         _drive(engine, prompt_len=12, greedy=False, tag=2)
+        _drive(engine, prompt_len=12, greedy=False, tag=5, constrained=True)
 
     def repeat():
         _drive(engine, prompt_len=12, greedy=True, tag=3)
         _drive(engine, prompt_len=12, greedy=False, tag=4)
+        _drive(engine, prompt_len=12, greedy=False, tag=6, constrained=True)
 
     warm_c, warm_e = count_new_compiles(engine, warm)
     pages_before = engine.pages
     toks_before = engine._tok_state
+    fsm_before = engine._fsm_state
     repeat_c, repeat_e = count_new_compiles(engine, repeat)
     report = PathReport(
         decode_path=decode_path,
         warm_compiles=warm_c, warm_events=warm_e,
         repeat_compiles=repeat_c, repeat_events=repeat_e,
         forbidden=scan_engine_programs(engine),
-        # The engine donates pages and the token buffer into every decode
-        # dispatch; after the repeat pass it must hold fresh outputs, not
-        # an alias of something it donated away.
+        # The engine donates pages and the token/FSM-state buffers into
+        # every constrained dispatch; after the repeat pass it must hold
+        # fresh outputs, not an alias of something it donated away.
         donated_pages_rebound=engine.pages is not pages_before,
         donated_tokens_rebound=engine._tok_state is not toks_before,
+        donated_fsm_rebound=engine._fsm_state is not fsm_before,
     )
     return report
 

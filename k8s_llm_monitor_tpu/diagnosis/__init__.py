@@ -1,0 +1,29 @@
+"""Diagnosis engine: grammar-constrained verdicts + standing pipeline.
+
+Closes the monitor→LLM loop (ROADMAP item 5): ``grammar`` compiles a JSON
+schema into a token-level FSM whose per-step masks run inside the serving
+engine's on-device sampler (Willard & Louf 2023 style guided generation),
+``pipeline`` turns the watcher event stream into batched root-cause
+queries with verdicts published as gauges + a ring-buffer history, and
+``session`` pins multi-turn follow-ups to the prefix-cached context.
+"""
+
+from k8s_llm_monitor_tpu.diagnosis.grammar import (  # noqa: F401
+    GrammarError,
+    TokenFSM,
+    VERDICT_SCHEMA,
+    compile_schema,
+    parse_verdict,
+    verdict_fsm,
+)
+from k8s_llm_monitor_tpu.diagnosis.pipeline import (  # noqa: F401
+    BurstDetector,
+    ContextAssembler,
+    DiagnosisEventHandler,
+    DiagnosisPipeline,
+    VerdictStore,
+)
+from k8s_llm_monitor_tpu.diagnosis.session import (  # noqa: F401
+    DiagnosisSession,
+    SessionManager,
+)

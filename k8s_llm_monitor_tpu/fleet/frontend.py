@@ -58,6 +58,12 @@ class FleetAnalysis:
             "context": request.context,
         }))
 
+    def diagnoses(self, limit: int = 0) -> dict:
+        """Raw replica payload for GET /api/v1/diagnoses — the handler
+        serves it verbatim, so router and replica answer the same shape
+        (plus the ``replica`` field saying who answered)."""
+        return self.router.diagnoses(limit)
+
     def close(self) -> None:
         self.router.registry.stop_probes()
         for rid in self.router.registry.ids():

@@ -66,6 +66,10 @@ class Replica:
     def analyze(self, payload: dict) -> dict:
         raise NotImplementedError(f"{self.replica_id}: query interface")
 
+    def diagnoses(self, limit: int = 0) -> dict:
+        """Verdict history from the replica's standing diagnosis pipeline."""
+        raise NotImplementedError(f"{self.replica_id}: query interface")
+
     def close(self) -> None:
         pass
 
@@ -200,6 +204,14 @@ class HTTPReplica(Replica):
 
         try:
             return self.client.analyze(payload)
+        except ApiConnectionError as exc:
+            raise ReplicaUnavailable(str(exc)) from exc
+
+    def diagnoses(self, limit: int = 0) -> dict:
+        from k8s_llm_monitor_tpu.monitor.client import ApiConnectionError
+
+        try:
+            return self.client.diagnoses(limit)
         except ApiConnectionError as exc:
             raise ReplicaUnavailable(str(exc)) from exc
 

@@ -588,6 +588,18 @@ class FleetRouter:
         self.registry.note_done(rid, ok=True)
         return out
 
+    def diagnoses(self, limit: int = 0) -> dict:
+        """Verdict history from any one replica's standing pipeline.  A
+        fixed digest keeps consecutive polls on the same replica (histories
+        are per-replica rings, so a stable view beats a merged one)."""
+        rid, out = self._dispatch_text(
+            self._text_digest("diagnoses"), lambda r: r.diagnoses(limit))
+        self.registry.note_done(rid, ok=True)
+        if isinstance(out, dict):
+            out = dict(out)
+            out["replica"] = rid
+        return out
+
     def query_stream(self, question: str):
         """Returns (request_id, model, delta iterator).  The iterator fails
         over mid-stream: a new replica re-answers and the already-delivered

@@ -25,6 +25,7 @@ from __future__ import annotations
 import http.client
 import json
 import logging
+import re
 import time
 import urllib.error
 import urllib.request
@@ -38,6 +39,12 @@ from k8s_llm_monitor_tpu.monitor.config import (
     LifecycleConfig,
     LLMConfig,
 )
+from k8s_llm_monitor_tpu.diagnosis.grammar import (
+    GrammarError,
+    parse_verdict,
+    render_verdict,
+)
+from k8s_llm_monitor_tpu.diagnosis.session import SessionManager
 from k8s_llm_monitor_tpu.resilience.errors import OverloadedError
 from k8s_llm_monitor_tpu.monitor.manager import Manager
 from k8s_llm_monitor_tpu.monitor.models import (
@@ -73,6 +80,37 @@ class LLMBackend:
         yield self.generate(prompt, max_tokens=max_tokens,
                             temperature=temperature)
 
+    def generate_constrained(self, prompt: str,
+                             temperature: float = 0.0) -> str:
+        """Return Verdict JSON valid under ``diagnosis.grammar``'s schema.
+
+        Default path for backends without token-level masking (remote
+        endpoints can't apply per-step logit masks): generate free text and
+        fold it into a canonical verdict via ``render_verdict``, so the
+        contract — output always parses — holds even when the model
+        rambles.  ``LocalEngineBackend`` overrides this with true on-device
+        FSM-constrained decoding.
+        """
+        text = self.generate(prompt, max_tokens=512,
+                             temperature=temperature).strip()
+        try:
+            parse_verdict(text)
+            return text
+        except GrammarError:
+            pass
+        low = text.lower()
+        if any(w in low for w in ("crash", "oom", "fail", "critical",
+                                  "unreachable", "down")):
+            severity = "critical"
+        elif any(w in low for w in ("warn", "pressure", "restart",
+                                    "degrad", "evict")):
+            severity = "warning"
+        else:
+            severity = "info"
+        return render_verdict(
+            severity, "cluster", text,
+            "see root_cause; re-run the diagnosis after remediation", 0.3)
+
 
 class TemplateBackend(LLMBackend):
     """Deterministic diagnosis text from the prompt's evidence sections.
@@ -102,6 +140,35 @@ class TemplateBackend(LLMBackend):
             "Diagnosis: no anomalies detected in the collected evidence. "
             "The cluster appears healthy; no action required."
         )
+
+    def generate_constrained(self, prompt: str,
+                             temperature: float = 0.0) -> str:
+        """Deterministic grammar-valid verdict from the evidence sections —
+        same extraction as ``generate``, rendered through the canonical
+        serializer so it parses under the verdict grammar by construction."""
+        issues = [
+            line.strip("- ").strip()
+            for line in prompt.splitlines()
+            if line.lstrip().startswith("- ") and "##" not in line
+        ]
+        if not issues:
+            return render_verdict(
+                "info", "cluster",
+                "no anomalies detected in the collected evidence",
+                "no action required", 0.9)
+        low = " ".join(issues).lower()
+        if any(w in low for w in ("crashloop", "crash", "oom", "failed",
+                                  "notready", "unreachable")):
+            severity = "critical"
+        else:
+            severity = "warning"
+        pod = re.search(r'"pod": "([^"]+)"', prompt)
+        component = pod.group(1) if pod else "cluster"
+        return render_verdict(
+            severity, component,
+            f"{len(issues)} finding(s): {'; '.join(issues[:3])}",
+            "address the findings in order; re-run the analysis after "
+            "each fix", 0.6)
 
 
 class LocalEngineBackend(LLMBackend):
@@ -159,6 +226,13 @@ class LocalEngineBackend(LLMBackend):
         else:
             assert engine is not None, "engine or engine_factory required"
             self._service = EngineService(engine)
+            if getattr(engine, "_grammar", None) is None:
+                self._install_verdict_grammar(engine, tokenizer)
+        # Decode-rate EMAs (ms/token) for the exporter's
+        # constrained_decode_overhead_ms gauge; plain float stores, benign
+        # under concurrent generate() threads.
+        self._ema_ms_constrained: float | None = None
+        self._ema_ms_free: float | None = None
         if dev_weights:
             # Random-init weights + byte tokenizer produce byte soup; make
             # that loud in every API response's `model` field instead of
@@ -185,6 +259,47 @@ class LocalEngineBackend(LLMBackend):
         if self.supervisor is not None:
             return self.supervisor.submit(prompt_ids, sampling)
         return self.service.submit(prompt_ids, sampling)
+
+    @staticmethod
+    def _install_verdict_grammar(engine, tokenizer) -> bool:
+        """Register the Verdict token-FSM on a fresh engine.
+
+        Byte tokenizer only: the grammar's char→token lift (token =
+        byte + 3) is exact for ``ByteTokenizer``; HF/BPE tokenizers would
+        need a subword-aware compile, so constrained submits are refused
+        for them (``generate_constrained`` falls back to the render path)
+        instead of silently emitting garbage.
+        """
+        from k8s_llm_monitor_tpu.diagnosis.grammar import verdict_fsm
+        from k8s_llm_monitor_tpu.utils.tokenizer import ByteTokenizer
+
+        if not isinstance(tokenizer, ByteTokenizer):
+            return False
+        if engine.cfg.vocab_size < ByteTokenizer.vocab_size:
+            return False
+        try:
+            engine.set_grammar(verdict_fsm(eos_id=tokenizer.eos_id))
+        except ValueError as exc:
+            logger.warning("verdict grammar not installed: %s", exc)
+            return False
+        return True
+
+    def _note_decode_ms(self, constrained: bool, n_tokens: int,
+                        latency_s: float, ttft_s: float) -> None:
+        if n_tokens <= 1:
+            return
+        ms = max(0.0, latency_s - ttft_s) * 1000.0 / (n_tokens - 1)
+        attr = "_ema_ms_constrained" if constrained else "_ema_ms_free"
+        prev = getattr(self, attr)
+        setattr(self, attr, ms if prev is None else 0.8 * prev + 0.2 * ms)
+
+    @property
+    def constrained_decode_overhead_ms(self) -> float:
+        """Per-token decode cost of FSM masking: EMA(constrained) −
+        EMA(free), clamped at 0; 0.0 until both classes have samples."""
+        if self._ema_ms_constrained is None or self._ema_ms_free is None:
+            return 0.0
+        return max(0.0, self._ema_ms_constrained - self._ema_ms_free)
 
     @classmethod
     def from_config(cls, tpu_cfg, lifecycle=None) -> "LocalEngineBackend":
@@ -291,7 +406,7 @@ class LocalEngineBackend(LLMBackend):
         # from baseline by construction.  Weights are jax.Arrays the dead
         # engine never mutates, so reuse is safe.
         def engine_factory() -> InferenceEngine:
-            return InferenceEngine(
+            engine = InferenceEngine(
                 cfg,
                 params,
                 EngineConfig(max_slots=tpu_cfg.max_batch,
@@ -300,6 +415,11 @@ class LocalEngineBackend(LLMBackend):
                 tokenizer=tokenizer,
                 mesh=mesh,
             )
+            # Inside the factory, not after it: supervisor rebuilds go
+            # through this closure, and a rebuilt engine without the
+            # grammar would reject every constrained submit.
+            cls._install_verdict_grammar(engine, tokenizer)
+            return engine
 
         return cls(tokenizer=tokenizer, dev_weights=dev_weights,
                    engine_factory=engine_factory, lifecycle=lifecycle)
@@ -316,7 +436,39 @@ class LocalEngineBackend(LLMBackend):
         res = handle.result(timeout=self.GENERATION_TIMEOUT_S)
         if res.finish_reason == "error":
             raise RuntimeError(f"generation failed: {res.error}")
+        self._note_decode_ms(False, len(res.token_ids),
+                             res.latency_s, res.ttft_s)
         return self.tokenizer.decode(res.token_ids)
+
+    def generate_constrained(self, prompt: str,
+                             temperature: float = 0.0) -> str:
+        """True grammar-constrained decoding: the verdict FSM's per-step
+        logit masks run inside the engine's on-device sampler, so the raw
+        token stream IS the verdict JSON — no post-hoc repair.  Falls back
+        to the base render path when no grammar is registered (HF
+        tokenizer, undersized vocab)."""
+        from k8s_llm_monitor_tpu.serving.engine import SamplingParams
+
+        try:
+            has_grammar = getattr(self.engine, "_grammar", None) is not None
+        except Exception:  # noqa: BLE001 — supervisor mid-rebuild
+            has_grammar = False
+        if not has_grammar:
+            return super().generate_constrained(prompt,
+                                                temperature=temperature)
+        handle = self._submit(
+            self.tokenizer.encode(prompt),
+            # max_tokens=1 is a floor: submit() raises it to the grammar's
+            # max accepting path so the verdict can always close.
+            SamplingParams(max_tokens=1, temperature=temperature,
+                           constrained=True),
+        )
+        res = handle.result(timeout=self.GENERATION_TIMEOUT_S)
+        if res.finish_reason == "error":
+            raise RuntimeError(f"constrained generation failed: {res.error}")
+        self._note_decode_ms(True, len(res.token_ids),
+                             res.latency_s, res.ttft_s)
+        return self.tokenizer.decode(res.token_ids).strip()
 
     def generate_stream(
         self, prompt: str, max_tokens: int = 512, temperature: float = 0.1
@@ -418,7 +570,10 @@ class OpenAICompatBackend(LLMBackend):
                 with self._post(body) as resp:
                     raw = resp.read()
                 try:
-                    data = json.loads(raw)
+                    # The envelope (choices/usage) is protocol JSON, not
+                    # model text; the text itself goes through the
+                    # generate_constrained -> parse_verdict funnel.
+                    data = json.loads(raw)  # graftcheck: disable=unconstrained-model-parse -- HTTP envelope
                 except ValueError as exc:
                     # 200 + non-JSON body (an LB/proxy error page): as
                     # transient as a 502, and must not surface as a
@@ -627,6 +782,9 @@ class AnalysisEngine:
         # content-aware outlier detection over event text to the
         # thresholds-only anomaly signals.
         self.anomaly_detector = anomaly_detector
+        # Multi-turn follow-up sessions (diagnosis/session.py); build_server
+        # replaces this with one sized from config.diagnosis.
+        self.sessions = SessionManager()
 
     # -- free-form NL question (the missing /api/v1/query) ---------------------
 
@@ -686,6 +844,85 @@ class AnalysisEngine:
             temperature=self.llm_cfg.temperature,
         )
         return request_id, self.backend.name, chunks
+
+    def query_session(self, question: str,
+                      session_id: str = "") -> AnalysisResponse:
+        """Multi-turn variant of ``query``: the cluster context is frozen
+        at session creation and replayed verbatim as the prompt prefix on
+        every follow-up, so the engine's PrefixCache (and fleet prefix
+        affinity) serve the shared context instead of re-prefilling it.
+        An empty ``session_id`` mints a new session; the id comes back in
+        the result for the next turn."""
+        request_id = uuid.uuid4().hex[:12]
+        try:
+            session, created = self.sessions.get_or_create(
+                session_id,
+                lambda: self.evidence.format_prompt(
+                    self.evidence.collect()) + "\n",
+            )
+            prompt = session.build_prompt(_SYSTEM_PREAMBLE, question)
+            answer = self.backend.generate(
+                prompt,
+                max_tokens=self.llm_cfg.max_tokens,
+                temperature=self.llm_cfg.temperature,
+            )
+            session.record(question, answer)
+            return AnalysisResponse(
+                request_id=request_id,
+                status="success",
+                result={
+                    "answer": answer,
+                    "model": self.backend.name,
+                    "session_id": session.session_id,
+                    "session_created": created,
+                    "turn": len(session.turns),
+                },
+            )
+        except OverloadedError:
+            raise  # mapped to 429/503 + Retry-After at the HTTP layer
+        except Exception as exc:  # noqa: BLE001 — API boundary
+            logger.exception("session query failed")
+            return AnalysisResponse(
+                request_id=request_id,
+                status="error",
+                error=str(exc),
+                error_kind="internal",
+            )
+
+    # -- grammar-constrained verdicts -------------------------------------------
+
+    def diagnose(self, question: str,
+                 context: str | None = None) -> dict[str, Any]:
+        """One grammar-constrained root-cause verdict as a parsed dict.
+
+        The contract callers (pipeline, ``_analyze_root_cause``) rely on:
+        the return value ALWAYS matches ``diagnosis.grammar.VERDICT_SCHEMA``
+        — keys severity/component/root_cause/recommendation/confidence.
+        ``context`` is pre-rendered evidence text (the pipeline passes its
+        assembled burst context); when omitted, live cluster evidence is
+        collected.
+        """
+        if context is None:
+            context = self.evidence.format_prompt(self.evidence.collect())
+        prompt = (
+            _SYSTEM_PREAMBLE
+            + context
+            + f"\n## Question\n{question}\n"
+            "## Verdict\nRespond with exactly one JSON object with keys "
+            "severity, component, root_cause, recommendation, confidence:\n"
+        )
+        text = self.backend.generate_constrained(
+            prompt, temperature=self.llm_cfg.temperature)
+        try:
+            return parse_verdict(text)
+        except GrammarError as exc:
+            # Defense in depth: the FSM makes this unreachable for the
+            # constrained engine path, but a misbehaving custom backend
+            # must not break the always-parses contract.
+            logger.warning("backend emitted grammar-invalid verdict: %s", exc)
+            return parse_verdict(render_verdict(
+                "warning", "cluster", text,
+                "re-run the diagnosis", 0.2))
 
     # -- typed analyses (ref pkg/models/models.go:85-99) ------------------------
 
@@ -828,9 +1065,15 @@ class AnalysisEngine:
             prompt, max_tokens=self.llm_cfg.max_tokens,
             temperature=self.llm_cfg.temperature,
         )
+        verdict = self.diagnose(
+            f"Root-cause analysis for {target}."
+            + (f" Reported symptom: {symptom}." if symptom else ""),
+            context=self.evidence.format_prompt(ev),
+        )
         return {
             "target": target,
             "root_cause_analysis": answer,
+            "verdict": verdict,
             "evidence": ev,
             "model": self.backend.name,
         }

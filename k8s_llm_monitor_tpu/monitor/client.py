@@ -547,6 +547,12 @@ class ApiClient:
     def stats(self) -> dict[str, Any]:
         return self._get_json("/api/v1/stats")
 
+    def diagnoses(self, limit: int = 0) -> dict[str, Any]:
+        path = "/api/v1/diagnoses"
+        if limit > 0:
+            path += f"?limit={int(limit)}"
+        return self._get_json(path)
+
     # -- queries (POST, never retried) ---------------------------------------
 
     def query(self, question: str) -> dict[str, Any]:

@@ -140,6 +140,34 @@ class AnalysisConfig:
 
 
 @dataclass
+class DiagnosisConfig:
+    """Standing watcher→LLM diagnosis pipeline (diagnosis/pipeline.py).
+    New; no reference equivalent — the reference never closed the
+    monitor→LLM loop."""
+
+    enabled: bool = True
+    # Burst detector: >= burst_threshold Warning events inside window_s
+    # triggers one root-cause query; cooldown_s suppresses immediate
+    # re-triggers while the same incident keeps emitting events.
+    burst_threshold: int = 5
+    window_s: float = 60.0
+    cooldown_s: float = 120.0
+    # Context assembly bounds: the event ring the assembler selects from,
+    # how many events each query includes (embedding top-k when
+    # analysis.embedding_model is set, else the most recent), and the hard
+    # character cap on the rendered context block.
+    max_context_events: int = 64
+    context_top_k: int = 8
+    max_context_chars: int = 2000
+    # Verdict ring exposed at GET /api/v1/diagnoses.
+    history: int = 64
+    # Multi-turn follow-up sessions (diagnosis/session.py): idle TTL and
+    # the LRU cap on concurrently pinned session contexts.
+    session_ttl_s: float = 600.0
+    max_sessions: int = 16
+
+
+@dataclass
 class LifecycleConfig:
     """Crash-safe serving lifecycle (resilience/journal.py +
     serving/supervisor.py + cmd/server.py signal handlers).  New; no
@@ -209,6 +237,7 @@ class Config:
     monitoring: MonitoringConfig = field(default_factory=MonitoringConfig)
     metrics: MetricsConfig = field(default_factory=MetricsConfig)
     analysis: AnalysisConfig = field(default_factory=AnalysisConfig)
+    diagnosis: DiagnosisConfig = field(default_factory=DiagnosisConfig)
     lifecycle: LifecycleConfig = field(default_factory=LifecycleConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)

@@ -266,6 +266,39 @@ def _fleet_metrics(w: _Writer, router) -> None:
              [("", round(router.hedge_delay_s(), 6))])
 
 
+def _diagnosis_metrics(w: _Writer, pipeline, backend) -> None:
+    """Standing diagnosis pipeline (PR 6): verdict counts by severity,
+    trigger→verdict lag, and the constrained-decode tax on the engine."""
+    if pipeline is not None:
+        counts = pipeline.store.counts()
+        w.metric("diagnosis_verdicts_total", "counter",
+                 "Verdicts published by the diagnosis pipeline, by severity",
+                 [(f'{{severity="{s}"}}', counts.get(s, 0))
+                  for s in pipeline.store.SEVERITIES])
+        w.metric("diagnosis_pipeline_lag_ms", "gauge",
+                 "Burst trigger to published verdict latency "
+                 "(most recent verdict)",
+                 [("", round(pipeline.store.lag_ms(), 3))])
+        w.metric("diagnosis_triggers_total", "counter",
+                 "Warning-event bursts that fired the pipeline",
+                 [("", pipeline.triggers_total)])
+        w.metric("diagnosis_queries_total", "counter",
+                 "Root-cause LLM queries the pipeline ran",
+                 [("", pipeline.queries_total)])
+        w.metric("diagnosis_errors_total", "counter",
+                 "Pipeline diagnosis attempts that raised",
+                 [("", pipeline.errors_total)])
+        w.metric("diagnosis_context_events", "gauge",
+                 "Cluster events held in the context ring buffer",
+                 [("", len(pipeline.context))])
+    overhead = getattr(backend, "constrained_decode_overhead_ms", None)
+    if overhead is not None:
+        w.metric("constrained_decode_overhead_ms", "gauge",
+                 "Per-token decode cost of FSM-constrained sampling vs "
+                 "free decoding (EMA delta; 0 until both paths observed)",
+                 [("", round(overhead, 4))])
+
+
 def _device_metrics(w: _Writer) -> None:
     try:
         import jax
@@ -320,5 +353,9 @@ def render_prometheus(srv: "MonitorServer") -> str:
         _fleet_metrics(w, router)
     if srv.manager is not None:
         _manager_metrics(w, srv.manager)
+    backend = getattr(srv.analysis, "backend", None)
+    pipeline = getattr(srv, "diagnosis", None)
+    if pipeline is not None or backend is not None:
+        _diagnosis_metrics(w, pipeline, backend)
     _device_metrics(w)
     return w.render()

@@ -68,11 +68,16 @@ class MonitorServer:
         web_dir: str | Path | None = None,
         host: str | None = None,
         port: int | None = None,
+        diagnosis=None,
     ) -> None:
         self.config = config or Config()
         self.client = client
         self.manager = manager
         self.analysis = analysis
+        # diagnosis.pipeline.DiagnosisPipeline — the standing watcher→LLM
+        # loop behind GET /api/v1/diagnoses and the diagnosis_* gauges.
+        # None on routers (they proxy) and in dev mode.
+        self.diagnosis = diagnosis
         self.web_dir = Path(web_dir) if web_dir else DEFAULT_WEB_DIR
         self.host = host if host is not None else self.config.server.host
         self.port = port if port is not None else self.config.server.port
@@ -212,9 +217,13 @@ class MonitorServer:
             target=self._httpd.serve_forever, name="monitor-http", daemon=True
         )
         self._thread.start()
+        if self.diagnosis is not None:
+            self.diagnosis.start()
         logger.info("monitor server listening on %s:%d", self.host, self.port)
 
     def stop(self) -> None:
+        if self.diagnosis is not None:
+            self.diagnosis.stop()
         if self._httpd is not None:
             self._httpd.shutdown()
             self._httpd.server_close()
@@ -224,6 +233,8 @@ class MonitorServer:
             self._thread = None
 
     def serve_forever(self) -> None:
+        if self.diagnosis is not None:
+            self.diagnosis.start()
         handler = _make_handler(self)
         self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
         self.port = self._httpd.server_address[1]
@@ -248,6 +259,7 @@ _ROUTES: dict[tuple[str, str], str] = {
     ("POST", "/api/v1/analyze/pod-communication"): "h_pod_comm",
     ("POST", "/api/v1/analyze"): "h_analyze",
     ("POST", "/api/v1/query"): "h_query",
+    ("GET", "/api/v1/diagnoses"): "h_diagnoses",
     ("GET", "/api/v1/metrics/cluster"): "h_metrics_cluster",
     ("GET", "/api/v1/metrics/nodes"): "h_metrics_nodes",
     ("GET", "/api/v1/metrics/pods"): "h_metrics_pods",
@@ -563,8 +575,57 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
                 return self._send_error_text("question is required", 400)
             if body.get("stream"):
                 return self._stream_query(question)
-            resp = srv.analysis.query(question)
+            # Multi-turn follow-ups: "session_id" (even "", which mints a
+            # new session) pins the conversation to one frozen cluster
+            # context whose token prefix replays every turn — PrefixCache
+            # hits locally, prefix-affinity in fleet mode.
+            if "session_id" in body:
+                if not hasattr(srv.analysis, "query_session"):
+                    return self._send_error_text(
+                        "sessions are not supported on this role", 400)
+                resp = srv.analysis.query_session(
+                    question, str(body.get("session_id") or ""))
+            else:
+                resp = srv.analysis.query(question)
             self._send_json(resp, status=200 if resp.status == "success" else 500)
+
+        def h_diagnoses(self) -> None:
+            """Verdict history from the standing diagnosis pipeline; on
+            router roles this proxies to a replica (FleetAnalysis)."""
+            query = parse_qs(urlparse(self.path).query)
+            try:
+                limit = int((query.get("limit", ["0"])[0]) or 0)
+            except ValueError:
+                return self._send_error_text("limit must be an integer", 400)
+            pipe = srv.diagnosis
+            if pipe is not None:
+                return self._send_json({
+                    "status": "success",
+                    "diagnoses": pipe.store.snapshot(limit),
+                    "count": len(pipe.store),
+                    "verdicts_total": pipe.store.counts(),
+                    "pipeline": {
+                        "triggers": pipe.triggers_total,
+                        "queries": pipe.queries_total,
+                        "errors": pipe.errors_total,
+                        "lag_ms": pipe.store.lag_ms(),
+                        "pending_events": pipe.detector.pending(),
+                        "context_events": len(pipe.context),
+                    },
+                    "timestamp": _now(),
+                })
+            proxy = getattr(srv.analysis, "diagnoses", None)
+            if callable(proxy):
+                try:
+                    return self._send_json(proxy(limit))
+                except OverloadedError:
+                    raise
+                except Exception as exc:  # noqa: BLE001 — fleet edge
+                    return self._send_error_text(
+                        f"diagnoses unavailable: {exc}", 502)
+            return self._send_error_text(
+                "Diagnosis pipeline not available - running in development "
+                "mode", 503)
 
         def _stream_query(self, question: str) -> None:
             """Server-sent events: one `data:` JSON per answer-text delta as
@@ -878,6 +939,7 @@ def build_server(
     """Wire the full server from config: cluster backend → client → manager
     → analysis engine → HTTP. ``backend=None`` boots dev mode (no cluster),
     like the reference's nil-client path (cmd/server/main.go:43-51)."""
+    from k8s_llm_monitor_tpu.diagnosis.session import SessionManager
     from k8s_llm_monitor_tpu.monitor.analysis import build_backend
 
     client = None
@@ -923,10 +985,26 @@ def build_server(
         llm_cfg=config.llm,
         anomaly_detector=detector,
     )
+    analysis.sessions = SessionManager(
+        ttl_s=config.diagnosis.session_ttl_s,
+        max_sessions=config.diagnosis.max_sessions,
+    )
+    diagnosis = None
+    if config.diagnosis.enabled:
+        # The pipeline is constructed here but its worker thread starts
+        # with the HTTP server (start()/serve_forever()); the Watcher
+        # feeding it is wired by cmd/server.py, which owns thread
+        # lifecycles.  The embedding detector doubles as the retrieval
+        # encoder for context assembly.
+        from k8s_llm_monitor_tpu.diagnosis.pipeline import DiagnosisPipeline
+
+        diagnosis = DiagnosisPipeline(
+            analysis, config.diagnosis, embedder=detector)
     return MonitorServer(
         config=config,
         client=client,
         manager=manager,
         analysis=analysis,
         web_dir=web_dir,
+        diagnosis=diagnosis,
     )

@@ -121,6 +121,63 @@ def sample_tokens_bounded(
                      sampled.astype(jnp.int32))
 
 
+# Large-negative instead of -inf for FSM-disallowed entries: a fully
+# finite row keeps softmax/categorical NaN-free even before the grammar's
+# >=1-allowed-token guarantee kicks in, and survives the /temperature
+# scaling in both samplers without overflow (1e9 / 1e-6 = 1e15 << f32 max).
+_FSM_NEG = -1e9
+
+
+def fsm_allowed_mask(fsm_state: jnp.ndarray, fsm_trans: jnp.ndarray,
+                     vocab: int) -> jnp.ndarray:
+    """Per-lane allowed-token mask from a grammar FSM.
+
+    Args:
+      fsm_state: [B] int32 — per-lane state; 0 is the FREE state (lane is
+        unconstrained, everything allowed).
+      fsm_trans: [S, Vg] int32 — dense transition table (diagnosis.grammar
+        ``TokenFSM.trans``); entry >= 0 allowed, -1 disallowed.
+      vocab: model vocab size V (>= Vg); tokens past the grammar vocab are
+        disallowed for constrained lanes.
+
+    Returns: [B, V] bool.
+    """
+    rows = fsm_trans[jnp.clip(fsm_state, 0, fsm_trans.shape[0] - 1)]
+    allowed = rows >= 0
+    if vocab > fsm_trans.shape[1]:
+        pad = jnp.zeros(
+            (allowed.shape[0], vocab - fsm_trans.shape[1]), dtype=bool)
+        allowed = jnp.concatenate([allowed, pad], axis=-1)
+    return allowed | (fsm_state <= 0)[:, None]
+
+
+def fsm_mask_logits(logits: jnp.ndarray, fsm_state: jnp.ndarray,
+                    fsm_trans: jnp.ndarray) -> jnp.ndarray:
+    """Mask grammar-disallowed tokens to a large negative BEFORE sampling.
+
+    Masking ahead of ``sample_tokens``/``sample_tokens_bounded`` (rather
+    than inside them) keeps one distribution definition: greedy lanes
+    (temperature <= 0) take the argmax of the *masked* logits, so a
+    constrained-greedy lane is exact too.
+    """
+    allowed = fsm_allowed_mask(fsm_state, fsm_trans, logits.shape[-1])
+    return jnp.where(allowed, logits.astype(jnp.float32), _FSM_NEG)
+
+
+def fsm_advance(fsm_state: jnp.ndarray, fsm_trans: jnp.ndarray,
+                tokens: jnp.ndarray) -> jnp.ndarray:
+    """Next per-lane FSM state after ``tokens`` ([B] int32).
+
+    FREE lanes stay at 0 by table construction (row 0 is all-zero); token
+    ids beyond the grammar vocab are clipped — a constrained lane can never
+    sample one (they are masked), and for free lanes any index reads row
+    entries that all map to 0.
+    """
+    state = jnp.clip(fsm_state, 0, fsm_trans.shape[0] - 1)
+    tok = jnp.clip(tokens, 0, fsm_trans.shape[1] - 1)
+    return fsm_trans[state, tok].astype(jnp.int32)
+
+
 def sample_tokens(
     rng: jax.Array,
     logits: jnp.ndarray,

@@ -63,6 +63,8 @@ from k8s_llm_monitor_tpu.models import llama
 from k8s_llm_monitor_tpu.models.config import ModelConfig
 from k8s_llm_monitor_tpu.resilience.faults import FaultError, get_injector
 from k8s_llm_monitor_tpu.ops.sampling import (
+    fsm_advance,
+    fsm_mask_logits,
     greedy_tokens,
     sample_tokens,
     sample_tokens_bounded,
@@ -86,6 +88,12 @@ class SamplingParams:
     temperature: float = 0.0   # <= 0 -> greedy
     top_k: int = 0             # <= 0 -> disabled
     top_p: float = 1.0         # >= 1 -> disabled
+    # Grammar-constrained decoding (diagnosis/grammar.py): every sampled
+    # token is masked by the engine's installed TokenFSM so the output is
+    # schema-valid by construction.  Requires ``set_grammar()`` before
+    # submit; max_tokens is raised to the grammar's max_len so the forced
+    # EOS is always reachable.
+    constrained: bool = False
 
 
 @dataclasses.dataclass
@@ -450,6 +458,33 @@ class InferenceEngine:
             )
             return greedy_tokens(logits), pages
 
+        def _prefill_sample_fsm_fn(params, tokens, lengths, pages, tables,
+                                   fstate, ftrans, temp, topk, topp, rng):
+            # Grammar-constrained admission: mask the first-token logits by
+            # each lane's FSM state (0 = FREE lane, unmasked) BEFORE the
+            # shared sampler — greedy lanes take the argmax of the masked
+            # logits inside sample_tokens, so constrained-greedy is exact.
+            logits, pages = llama.prefill(
+                params, cfg, tokens, lengths, pages, tables
+            )
+            masked = fsm_mask_logits(logits, fstate, ftrans)
+            first = sample_tokens(
+                rng, masked, temperature=temp, top_k=topk, top_p=topp
+            )
+            return first, fsm_advance(fstate, ftrans, first), pages
+
+        def _prefill_chunk_sample_fsm_fn(params, tokens, start, lengths,
+                                         pages, tables, fstate, ftrans,
+                                         temp, topk, topp, rng):
+            logits, pages = llama.prefill_chunk(
+                params, cfg, tokens, start, lengths, pages, tables
+            )
+            masked = fsm_mask_logits(logits, fstate, ftrans)
+            first = sample_tokens(
+                rng, masked, temperature=temp, top_k=topk, top_p=topp
+            )
+            return first, fsm_advance(fstate, ftrans, first), pages
+
         def _place_fn(tok_state, first, idx):
             # Scatter freshly sampled first tokens into the device-resident
             # token buffer; padding lanes carry idx == max_slots and drop.
@@ -462,7 +497,22 @@ class InferenceEngine:
             _prefill_chunk_sample_fn, donate_argnums=(4,))
         self._prefill_chunk_greedy = jax.jit(
             _prefill_chunk_greedy_fn, donate_argnums=(4,))
+        self._prefill_sample_fsm = jax.jit(
+            _prefill_sample_fsm_fn, donate_argnums=(3,))
+        self._prefill_chunk_sample_fsm = jax.jit(
+            _prefill_chunk_sample_fsm_fn, donate_argnums=(4,))
         self._place_tokens = jax.jit(_place_fn, donate_argnums=(0,))
+        # Grammar-constrained decoding state (set_grammar): host TokenFSM,
+        # its device transition table, and the device-resident per-lane FSM
+        # state — data-dependent like _tok_state, so it must live on device
+        # to survive dispatch-ahead.  Lane state 0 is FREE (unconstrained);
+        # _place_fsm (re)writes lanes at admission, zeroing reused slots.
+        self._grammar = None
+        self._fsm_trans = None
+        self._fsm_state = jnp.zeros((ec.max_slots,), jnp.int32)
+        self._place_fsm = jax.jit(
+            lambda f, v, idx: f.at[idx].set(v, mode="drop"),
+            donate_argnums=(0,))
         # Fused-decode programs, built lazily per (n_steps, sampled).
         self._decode_cache: dict[tuple, Any] = {}
 
@@ -512,6 +562,7 @@ class InferenceEngine:
         self.watchdog_trips = 0
         self.deadline_expired = 0
         self.requeues = 0
+        self.constrained_requests = 0
         # EMA of submit->admission wait; a shed signal when slots churn
         # slower than the arrival rate.
         self.slot_wait_ema_s = 0.0
@@ -560,11 +611,54 @@ class InferenceEngine:
                 # the original-prompt prefix, not the generated tail.
                 req.orig_prompt_len = max(0, req.orig_prompt_len - overflow)
 
+    def set_grammar(self, fsm) -> None:
+        """Install the :class:`~..diagnosis.grammar.TokenFSM` constrained
+        requests decode against.  One grammar per engine (the verdict
+        schema); the dense table moves to device once, and every program
+        variant closes over nothing — the table is a runtime argument, so
+        swapping grammars of the same shape costs no recompile."""
+        if fsm.vocab_size > self.cfg.vocab_size:
+            raise ValueError(
+                f"grammar vocab {fsm.vocab_size} exceeds model vocab "
+                f"{self.cfg.vocab_size}")
+        if fsm.eos_id != self.eos_id:
+            raise ValueError(
+                f"grammar eos_id {fsm.eos_id} != engine eos_id {self.eos_id}")
+        self._grammar = fsm
+        self._fsm_trans = jnp.asarray(fsm.trans)
+
+    def _fsm_entry(self, req: GenerationRequest) -> int:
+        """FSM state for ``req``'s next sampled token: the grammar start
+        state walked through any generated tokens folded back into the
+        prompt by preemption / pipeline-reset requeue.  A fold that the
+        grammar rejects (only possible if the grammar changed under a
+        supervisor rebuild — a documented limitation) restarts from the
+        grammar start state rather than silently dropping the constraint."""
+        if not req.sampling.constrained or self._grammar is None:
+            return 0
+        gen = (req.prompt_ids[req.orig_prompt_len:]
+               if req.orig_prompt_len >= 0 else [])
+        state = self._grammar.walk(gen)
+        return state if state > 0 else self._grammar.start
+
     def submit(self, req: GenerationRequest) -> None:
         if not req.prompt_ids:
             raise ValueError("empty prompt")
         if req.sampling.max_tokens < 1:
             raise ValueError("max_tokens must be >= 1")
+        if req.sampling.constrained:
+            if self._grammar is None:
+                raise ValueError(
+                    "constrained sampling requires set_grammar() first")
+            self.constrained_requests += 1
+            # Guarantee the forced EOS is reachable within budget: the
+            # grammar's longest accepted sequence bounds generation, so
+            # raising max_tokens to it never produces more tokens — it only
+            # prevents a mid-object "length" truncation.
+            ml = self._grammar.max_len
+            if ml > 0 and req.sampling.max_tokens < ml:
+                req.sampling = dataclasses.replace(
+                    req.sampling, max_tokens=ml)
         self._cap_request(req)
         self._pending.append(req)
 
@@ -1143,6 +1237,7 @@ class InferenceEngine:
              if any_shared else 0)
         (tokens, start, lengths, tables, idx,
          temp, topk, topp) = self._lane_buffers(P, bucket, W)
+        fstate = np.zeros((P,), np.int32)
         for j, (slot_idx, req, blocks, st) in enumerate(batch):
             L = len(req.prompt_ids)
             if req.orig_prompt_len < 0:
@@ -1158,12 +1253,27 @@ class InferenceEngine:
             idx[j] = slot_idx
             sp = req.sampling
             temp[j], topk[j], topp[j] = sp.temperature, sp.top_k, sp.top_p
+            if sp.constrained:
+                fstate[j] = self._fsm_entry(req)
 
         all_greedy = all(r.sampling.temperature <= 0.0 for _, r, _, _ in batch)
+        # Any constrained lane forces the FSM program family (sampled-shape,
+        # masked logits); free lanes ride along at state 0, and greedy lanes
+        # stay exact via argmax-of-masked inside the shared sampler.
+        constrained = any(r.sampling.constrained for _, r, _, _ in batch)
+        fnext = None
         try:
             self._faults.maybe_raise("prefill_dispatch")
             if not any_shared:
-                if all_greedy:
+                if constrained:
+                    self._rng, sub = jax.random.split(self._rng)
+                    first, fnext, self.pages = self._prefill_sample_fsm(
+                        self.params, self._tokens_to_device(tokens), jnp.asarray(lengths),
+                        self.pages, jnp.asarray(tables), jnp.asarray(fstate),
+                        self._fsm_trans, jnp.asarray(temp),
+                        jnp.asarray(topk), jnp.asarray(topp), sub,
+                    )
+                elif all_greedy:
                     first, self.pages = self._prefill_greedy(
                         self.params, self._tokens_to_device(tokens), jnp.asarray(lengths),
                         self.pages, jnp.asarray(tables),
@@ -1176,7 +1286,16 @@ class InferenceEngine:
                         jnp.asarray(topk), jnp.asarray(topp), sub,
                     )
             else:
-                if all_greedy:
+                if constrained:
+                    self._rng, sub = jax.random.split(self._rng)
+                    first, fnext, self.pages = self._prefill_chunk_sample_fsm(
+                        self.params, self._tokens_to_device(tokens), jnp.asarray(start),
+                        jnp.asarray(lengths), self.pages, jnp.asarray(tables),
+                        jnp.asarray(fstate), self._fsm_trans,
+                        jnp.asarray(temp), jnp.asarray(topk),
+                        jnp.asarray(topp), sub,
+                    )
+                elif all_greedy:
                     first, self.pages = self._prefill_chunk_greedy(
                         self.params, self._tokens_to_device(tokens), jnp.asarray(start),
                         jnp.asarray(lengths), self.pages, jnp.asarray(tables),
@@ -1213,7 +1332,7 @@ class InferenceEngine:
             for slot_idx, req, blocks, st in batch:
                 self.prefix_cache.register(req.prompt_ids, blocks)
         self._finish_admit_dispatch(
-            first, [(s, r, b) for s, r, b, _ in batch], idx)
+            first, [(s, r, b) for s, r, b, _ in batch], idx, fsm_next=fnext)
         return True
 
     def _dispatch_prefill_chunks(self) -> bool:
@@ -1251,9 +1370,11 @@ class InferenceEngine:
                                 - s.prefill_pos) for _, s in cands))
         (tokens, start, lengths, tables, idx,
          temp, topk, topp) = self._lane_buffers(P, bucket, W)
+        fstate = np.zeros((P,), np.int32)
         lanes: list[tuple] = []
         touched: list[_Slot] = []
         final_greedy = True
+        final_constrained = False
         # (slot, chunk_len, became_final) — enough to roll every slot
         # mutation back if the dispatch itself fails.
         muts: list[tuple[_Slot, int, bool]] = []
@@ -1280,15 +1401,30 @@ class InferenceEngine:
                 sp = s.req.sampling
                 temp[j], topk[j], topp[j] = sp.temperature, sp.top_k, sp.top_p
                 final_greedy = final_greedy and sp.temperature <= 0.0
+                if sp.constrained:
+                    final_constrained = True
+                    fstate[j] = self._fsm_entry(s.req)
                 idx[j] = i
                 lanes.append((j, i, s.req))
                 if self.prefix_cache is not None:
                     to_register.append(s)
             muts.append((s, n, became_final))
 
+        fnext = None
         try:
             self._faults.maybe_raise("prefill_dispatch")
-            if final_greedy:
+            if final_constrained:
+                # Only final lanes sample, so only they consult the FSM;
+                # non-final lanes stay at state 0 and drop their token (and
+                # state) via the out-of-range idx scatter.
+                self._rng, sub = jax.random.split(self._rng)
+                first, fnext, self.pages = self._prefill_chunk_sample_fsm(
+                    self.params, self._tokens_to_device(tokens), jnp.asarray(start),
+                    jnp.asarray(lengths), self.pages, jnp.asarray(tables),
+                    jnp.asarray(fstate), self._fsm_trans, jnp.asarray(temp),
+                    jnp.asarray(topk), jnp.asarray(topp), sub,
+                )
+            elif final_greedy:
                 first, self.pages = self._prefill_chunk_greedy(
                     self.params, self._tokens_to_device(tokens), jnp.asarray(start),
                     jnp.asarray(lengths), self.pages, jnp.asarray(tables),
@@ -1315,15 +1451,27 @@ class InferenceEngine:
         for s in to_register:
             self.prefix_cache.register(s.req.prompt_ids, s.blocks)
         self.prefills += len(lanes)
-        self._queue_inflight("chunk", first, idx, lanes, touched)
+        self._queue_inflight("chunk", first, idx, lanes, touched,
+                             fsm_next=fnext)
         return True
 
     def _queue_inflight(self, kind: str, first, idx, lanes,
-                        touched=()) -> None:
+                        touched=(), fsm_next=None) -> None:
         """Shared dispatch tail: place sampled tokens into the device token
         buffer, start the async host copy, and queue the reconcile entry."""
         self._tok_state = self._place_tokens(
             self._tok_state, first, jnp.asarray(idx))
+        if self._fsm_trans is not None:
+            # With a grammar installed, every admission (re)writes its
+            # lanes' FSM states: the post-first-token state for constrained
+            # lanes, zero for free lanes — which also clears stale state
+            # left by a previous constrained occupant of a reused slot.
+            # Same ordering argument as _tok_state: the scatter is enqueued
+            # after the producing call and before any consuming decode.
+            self._fsm_state = self._place_fsm(
+                self._fsm_state,
+                fsm_next if fsm_next is not None else jnp.zeros_like(first),
+                jnp.asarray(idx))
         try:
             first.copy_to_host_async()
         except AttributeError:  # non-jax array (tests with stub impls)
@@ -1333,7 +1481,8 @@ class InferenceEngine:
             lanes=list(lanes), touched=list(touched)))
         self._next_call_id += 1
 
-    def _finish_admit_dispatch(self, first, batch, idx) -> None:
+    def _finish_admit_dispatch(self, first, batch, idx,
+                               fsm_next=None) -> None:
         """Admission tail: occupy slots, then queue via the shared path."""
         lanes = []
         for slot_idx, req, blocks in batch:
@@ -1343,12 +1492,12 @@ class InferenceEngine:
             lanes.append((slot_idx, req))
         self.prefills += len(batch)
         self._write_hist(lanes)
-        self._queue_inflight("admit", first, idx, lanes)
+        self._queue_inflight("admit", first, idx, lanes, fsm_next=fsm_next)
 
     # -- decode ---------------------------------------------------------
 
     def _decode_program(self, n_steps: int, sampled: bool,
-                        bounded: bool = False):
+                        bounded: bool = False, constrained: bool = False):
         """Build (and cache) the fused K-step decode program.
 
         The scan carries (token, ctx, done, pages[, rng]) on device: each
@@ -1362,8 +1511,15 @@ class InferenceEngine:
         ``sample_topk_cap`` logits per step instead of rank-sorting the
         full vocab — distribution-exact when every sampling lane has
         0 < top_k <= cap, which _dispatch_decode verifies per call.
+
+        ``constrained`` (static, sampled programs only): the scan also
+        carries the per-lane grammar FSM state — each step masks logits by
+        the lane's allowed-token row before the shared sampler and advances
+        the state by the sampled token.  Lanes at state 0 (FREE) are
+        untouched, so one constrained program serves mixed batches; the
+        transition table is a runtime argument (no recompile per grammar).
         """
-        key = (n_steps, sampled, bounded)
+        key = (n_steps, sampled, bounded, constrained)
         prog = self._decode_cache.get(key)
         if prog is not None:
             return prog
@@ -1380,7 +1536,41 @@ class InferenceEngine:
             )
             return logits, pages
 
-        if sampled:
+        if sampled and constrained:
+            def fn(params, tok_state, fsm_state, ctx, remaining, pages,
+                   tables, ftrans, temp, topk, topp, rng, eos):
+                active0 = ctx > 0
+
+                def body(carry, i):
+                    tokens, fstate, ctx, done, rng, pages = carry
+                    act = active0 & ~done & (i < remaining)
+                    logits, pages = _step_core(
+                        params, tokens, ctx, act, pages, tables)
+                    logits = fsm_mask_logits(logits, fstate, ftrans)
+                    rng, sub = jax.random.split(rng)
+                    if bounded:
+                        nxt = sample_tokens_bounded(
+                            sub, logits, temperature=temp, top_k=topk,
+                            top_p=topp, k_cap=k_cap)
+                    else:
+                        nxt = sample_tokens(sub, logits, temperature=temp,
+                                            top_k=topk, top_p=topp)
+                    nxt = jnp.where(act, nxt, tokens)
+                    fstate = jnp.where(
+                        act, fsm_advance(fstate, ftrans, nxt), fstate)
+                    done = done | (act & (nxt == eos))
+                    ctx = jnp.where(act, ctx + 1, ctx)
+                    out = jnp.where(act, nxt, -1)
+                    return (nxt, fstate, ctx, done, rng, pages), out
+
+                done0 = jnp.zeros_like(active0)
+                (tok_state, fsm_state, _, _, _, pages), toks = jax.lax.scan(
+                    body, (tok_state, fsm_state, ctx, done0, rng, pages),
+                    jnp.arange(n_steps, dtype=jnp.int32))
+                return toks, tok_state, fsm_state, pages
+
+            prog = jax.jit(fn, donate_argnums=(1, 2, 5))
+        elif sampled:
             def fn(params, tok_state, ctx, remaining, pages, tables,
                    temp, topk, topp, rng, eos):
                 active0 = ctx > 0
@@ -1652,8 +1842,11 @@ class InferenceEngine:
         # sequential decode samples from (spec.accept_sampled).  Whether a
         # given dispatch speculates is ADAPTIVE: below the measured
         # acceptance threshold the fused pipelined path wins, so spec runs
-        # only as a periodic probe until acceptance recovers.
-        spec = ec.spec_k > 0
+        # only as a periodic probe until acceptance recovers.  Grammar-
+        # constrained lanes force spec off: the verify pass samples from
+        # unmasked positions, so accepted drafts could violate the grammar.
+        spec = ec.spec_k > 0 and not any(
+            s.req.sampling.constrained for _, s in lanes)
         if (spec and self._spec_ema is not None
                 and self._spec_ema < ec.spec_min_accept):
             self._since_spec_probe += 1
@@ -1740,11 +1933,17 @@ class InferenceEngine:
 
         eos = jnp.asarray(self.eos_id, jnp.int32)
         all_greedy = all(s.req.sampling.temperature <= 0.0 for _, s in lanes)
+        # Recomputed from the final lane set (preemption above may have
+        # evicted the constrained lane): any constrained lane selects the
+        # FSM program; its free co-lanes run masked-by-nothing at state 0.
+        constrained = (self._fsm_trans is not None and any(
+            s.req.sampling.constrained for _, s in lanes))
         try:
             self._faults.maybe_raise("decode_dispatch")
             payload, kind = self._dispatch_decode_call(
-                spec, all_greedy, lanes, K, ctx, steps_arr, table,
-                temp, topk, topp, eos)
+                spec and not constrained, all_greedy, lanes, K, ctx,
+                steps_arr, table, temp, topk, topp, eos,
+                constrained=constrained)
         except Exception as exc:
             # Nothing reached the device: undo the in-flight accounting so
             # the same lanes re-dispatch next step (ctx_pred derives from
@@ -1763,11 +1962,37 @@ class InferenceEngine:
 
     def _dispatch_decode_call(self, spec: bool, all_greedy: bool, lanes,
                               K: int, ctx, steps_arr, table, temp, topk,
-                              topp, eos):
+                              topp, eos, constrained: bool = False):
         """The device-call half of :meth:`_dispatch_decode`, split out so
         the dispatch fault/rollback boundary wraps exactly the program
         call.  Returns ``(payload, kind)``."""
         ec = self.ecfg
+        if constrained:
+            # Grammar-masked fused decode: always the sampled program family
+            # (greedy lanes take argmax-of-masked inside the sampler), FSM
+            # state threaded through the scan carry and the device-resident
+            # [max_slots] buffer, exactly like _tok_state.
+            cap = ec.sample_topk_cap
+            bounded = cap > 0 and all(
+                0 < s.req.sampling.top_k <= cap
+                for _, s in lanes if s.req.sampling.temperature > 0.0)
+            prog = self._decode_program(K, sampled=True, bounded=bounded,
+                                        constrained=True)
+            self._rng, sub = jax.random.split(self._rng)
+            toks, self._tok_state, self._fsm_state, self.pages = prog(
+                self.params, self._tok_state, self._fsm_state,
+                jnp.asarray(ctx), jnp.asarray(steps_arr), self.pages,
+                jnp.asarray(table), self._fsm_trans, jnp.asarray(temp),
+                jnp.asarray(topk), jnp.asarray(topp), sub, eos,
+            )
+            payload: Any = toks
+            kind = "decode"
+            self.steps += K
+            try:
+                toks.copy_to_host_async()
+            except AttributeError:
+                pass
+            return payload, kind
         if spec:
             # Filters only matter on lanes that actually sample: a greedy
             # lane carrying top_p (a common client default) must not force

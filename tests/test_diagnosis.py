@@ -1,0 +1,471 @@
+"""Diagnosis subsystem: constrained-decode property tests, the standing
+watcher→LLM pipeline, sessions, and the synthetic crash-loop e2e.
+
+Layers:
+  * engine fuzz — every FSM-constrained sample on a real (tiny) engine
+    parses as a schema-valid verdict, across temperature/top-k/top-p;
+  * pipeline units — burst detector, context assembler, verdict store,
+    sessions, all on injected fake clocks;
+  * e2e — a fake watcher feeds a crash-loop burst through a real
+    MonitorServer (template backend): the verdict must land in
+    GET /api/v1/diagnoses AND the /metrics diagnosis gauges.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import jax
+
+from k8s_llm_monitor_tpu.diagnosis.grammar import (
+    GrammarError, parse_verdict, verdict_fsm)
+from k8s_llm_monitor_tpu.diagnosis.pipeline import (
+    BurstDetector, ContextAssembler, DiagnosisEventHandler,
+    DiagnosisPipeline, VerdictStore)
+from k8s_llm_monitor_tpu.diagnosis.session import (
+    MAX_TURNS, SessionManager)
+from k8s_llm_monitor_tpu.models import llama
+from k8s_llm_monitor_tpu.models.config import ModelConfig
+from k8s_llm_monitor_tpu.monitor.cluster import FakeCluster, seed_demo_cluster
+from k8s_llm_monitor_tpu.monitor.config import Config, DiagnosisConfig
+from k8s_llm_monitor_tpu.monitor.models import EventInfo
+from k8s_llm_monitor_tpu.monitor.server import build_server
+from k8s_llm_monitor_tpu.serving.engine import (
+    EngineConfig, InferenceEngine, SamplingParams)
+from k8s_llm_monitor_tpu.utils.tokenizer import ByteTokenizer
+
+CFG = ModelConfig(name="tiny", vocab_size=300, hidden_size=32,
+                  intermediate_size=64, num_layers=2, num_heads=4,
+                  num_kv_heads=2, dtype="float32", rope_theta=1e4)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float) -> None:
+        self.t += dt
+
+
+# -- engine fuzz: every constrained sample parses ----------------------------
+
+
+@pytest.fixture(scope="module")
+def constrained_engine():
+    tok = ByteTokenizer()
+    params = llama.init_params(jax.random.PRNGKey(0), CFG)
+    engine = InferenceEngine(
+        CFG, params,
+        EngineConfig(max_slots=2, num_blocks=512, block_size=16,
+                     max_blocks_per_seq=128, prefill_buckets=(64, 128, 512),
+                     decode_steps_per_iter=4),
+        tokenizer=tok,
+    )
+    engine.set_grammar(verdict_fsm(eos_id=tok.eos_id))
+    return engine, tok
+
+
+@pytest.mark.slow  # real-engine compile; `make diagnose-e2e` runs these
+@pytest.mark.parametrize("temperature,top_k,top_p", [
+    (0.0, 0, 1.0),     # greedy
+    (0.7, 0, 1.0),
+    (1.0, 50, 1.0),    # top-k
+    (1.3, 0, 0.9),     # top-p
+    (0.9, 20, 0.95),   # both filters
+    (2.0, 5, 0.8),     # hot + tight filters
+])
+def test_constrained_samples_always_parse(constrained_engine, temperature,
+                                          top_k, top_p):
+    """The 100%-schema-valid property: whatever the sampler draws under the
+    FSM mask — any temperature, any top-k/top-p — must parse as a verdict."""
+    engine, tok = constrained_engine
+    prompt = tok.encode("## Question\nwhy is default/web crashlooping?\n")
+    results = engine.generate(
+        [prompt, prompt],
+        SamplingParams(max_tokens=1, temperature=temperature,
+                       top_k=top_k, top_p=top_p, constrained=True))
+    for res in results:
+        assert res.finish_reason in ("eos", "stop", "length"), res
+        text = tok.decode(res.token_ids)
+        verdict = parse_verdict(text)  # GrammarError == test failure
+        assert verdict["severity"] in ("info", "warning", "critical")
+        assert verdict["root_cause"]
+
+
+@pytest.mark.slow  # shares the real-engine fixture above
+def test_constrained_and_free_lanes_share_a_batch(constrained_engine):
+    """Mixed batches: a FREE-state lane (state 0) must decode unconstrained
+    in the same program that masks the constrained lane."""
+    engine, tok = constrained_engine
+    prompt = tok.encode("status?")
+    [free] = engine.generate([prompt], SamplingParams(max_tokens=8))
+    [forced] = engine.generate(
+        [prompt], SamplingParams(max_tokens=1, constrained=True))
+    assert len(free.token_ids) <= 8
+    parse_verdict(tok.decode(forced.token_ids))
+    with pytest.raises(GrammarError):
+        parse_verdict(tok.decode(free.token_ids) or "x")
+
+
+def test_constrained_submit_requires_grammar():
+    tok = ByteTokenizer()
+    params = llama.init_params(jax.random.PRNGKey(1), CFG)
+    engine = InferenceEngine(
+        CFG, params,
+        EngineConfig(max_slots=1, num_blocks=64, block_size=16,
+                     max_blocks_per_seq=32, prefill_buckets=(64,)),
+        tokenizer=tok)
+    with pytest.raises((ValueError, RuntimeError)):
+        engine.generate([tok.encode("x")],
+                        SamplingParams(max_tokens=1, constrained=True))
+
+
+# -- burst detector ----------------------------------------------------------
+
+
+def test_burst_detector_fires_at_threshold_once():
+    clk = FakeClock()
+    det = BurstDetector(threshold=3, window_s=60, cooldown_s=120, clock=clk)
+    assert not det.observe()
+    assert not det.observe()
+    assert det.observe()          # third event inside the window fires
+    assert det.pending() == 0     # window consumed by the firing
+    assert not det.observe()      # needs 3 fresh events again
+
+
+def test_burst_detector_window_expiry():
+    clk = FakeClock()
+    det = BurstDetector(threshold=3, window_s=10, cooldown_s=0, clock=clk)
+    det.observe()
+    clk.tick(11)                  # first event ages out of the window
+    det.observe()
+    assert not det.observe()      # only 2 inside the window
+    assert det.pending() == 2
+
+
+def test_burst_detector_cooldown_suppresses_refire():
+    clk = FakeClock()
+    det = BurstDetector(threshold=2, window_s=60, cooldown_s=30, clock=clk)
+    det.observe()
+    assert det.observe()
+    clk.tick(5)
+    det.observe()
+    assert not det.observe()      # threshold met but inside cooldown
+    clk.tick(30)
+    # Suppressed events stayed in the window, so the first observation
+    # after the cooldown elapses fires immediately.
+    assert det.observe()
+
+
+def test_burst_detector_rejects_bad_threshold():
+    with pytest.raises(ValueError):
+        BurstDetector(threshold=0)
+
+
+# -- context assembler -------------------------------------------------------
+
+
+def test_context_assembler_recency_fallback_and_budget():
+    ctx = ContextAssembler(capacity=4, top_k=2, max_chars=200)
+    for i in range(6):
+        ctx.add(f"event {i}")
+    assert len(ctx) == 4                       # ring capacity
+    block = ctx.assemble("anything")
+    assert "event 4" in block and "event 5" in block
+    assert "event 2" not in block              # top_k=2, most recent win
+    tight = ContextAssembler(capacity=4, top_k=4, max_chars=40)
+    tight.add("x" * 30)
+    tight.add("y" * 30)
+    assert "y" not in tight.assemble()         # char budget stops the block
+
+
+def test_context_assembler_empty():
+    assert "none observed" in ContextAssembler().assemble("q")
+
+
+def test_context_assembler_embedding_retrieval():
+    import numpy as np
+
+    class KeywordEmbedder:
+        """Unit vectors: axis 0 iff 'oom' in text, axis 1 otherwise."""
+
+        def embed(self, texts):
+            return np.array([[1.0, 0.0] if "oom" in t else [0.0, 1.0]
+                             for t in texts])
+
+    ctx = ContextAssembler(capacity=8, top_k=2, embedder=KeywordEmbedder())
+    for i in range(4):
+        ctx.add(f"scheduling noise {i}")
+    ctx.add("oom killed container web")
+    ctx.add("oom killed container db")
+    block = ctx.assemble("why the oom kills?")
+    assert "oom killed container web" in block
+    assert "oom killed container db" in block
+    assert "noise" not in block
+
+
+def test_context_assembler_broken_embedder_falls_back():
+    class Boom:
+        def embed(self, texts):
+            raise RuntimeError("no encoder")
+
+    ctx = ContextAssembler(capacity=8, top_k=1, embedder=Boom())
+    ctx.add("old")
+    ctx.add("new")
+    assert "new" in ctx.assemble("q") and "old" not in ctx.assemble("q")
+
+
+# -- verdict store -----------------------------------------------------------
+
+
+def _verdict(sev="warning"):
+    return {"severity": sev, "component": "c", "root_cause": "r",
+            "recommendation": "f", "confidence": 0.5}
+
+
+def test_verdict_store_counts_lag_and_order():
+    store = VerdictStore(capacity=2)
+    store.publish(_verdict("info"), trigger="a", lag_ms=10.0)
+    store.publish(_verdict("critical"), trigger="b", lag_ms=20.0)
+    store.publish(_verdict("critical"), trigger="c", lag_ms=5.0)
+    assert len(store) == 2                      # ring trimmed
+    snap = store.snapshot()
+    assert [e["trigger"] for e in snap] == ["c", "b"]   # newest first
+    assert store.snapshot(limit=1)[0]["trigger"] == "c"
+    assert store.counts() == {"info": 1, "warning": 0, "critical": 2}
+    assert store.lag_ms() == 5.0
+    assert snap[0]["timestamp"]
+
+
+# -- sessions ----------------------------------------------------------------
+
+
+def test_session_manager_pins_context_and_mints_ids():
+    clk = FakeClock()
+    mgr = SessionManager(ttl_s=100, max_sessions=4, clock=clk)
+    calls = []
+
+    def ctx():
+        calls.append(1)
+        return f"CTX-{len(calls)}\n"
+
+    s1, created = mgr.get_or_create("", ctx)
+    assert created and len(s1.session_id) == 12
+    s2, created = mgr.get_or_create(s1.session_id, ctx)
+    assert s2 is s1 and not created
+    assert calls == [1]                        # context_fn ran once: pinned
+    p1 = s1.build_prompt("PRE\n", "q1")
+    s1.record("q1", "a1")
+    p2 = s1.build_prompt("PRE\n", "q2")
+    assert p1.startswith("PRE\nCTX-1\n")       # byte-identical prefix
+    assert p2.startswith(p1[: p1.rindex("## Question")])
+    assert "a1" in p2 and p2.endswith("## Answer\n")
+
+
+def test_session_turn_window_and_answer_truncation():
+    clk = FakeClock()
+    mgr = SessionManager(clock=clk)
+    s, _ = mgr.get_or_create("", lambda: "C\n")
+    for i in range(MAX_TURNS + 3):
+        s.record(f"q{i}", "a" * 2000)
+    prompt = s.build_prompt("P", "next")
+    assert "q0" not in prompt and f"q{MAX_TURNS + 2}" in prompt
+    assert "a" * 2000 not in prompt            # MAX_ANSWER_CHARS cap
+
+
+def test_session_ttl_and_lru_eviction():
+    clk = FakeClock()
+    mgr = SessionManager(ttl_s=50, max_sessions=2, clock=clk)
+    a, _ = mgr.get_or_create("a", lambda: "ctx")
+    clk.tick(60)
+    assert mgr.get("a") is None                # TTL eviction
+    mgr.get_or_create("b", lambda: "ctx")
+    clk.tick(1)
+    mgr.get_or_create("c", lambda: "ctx")
+    clk.tick(1)
+    mgr.get_or_create("d", lambda: "ctx")      # over cap: LRU ("b") out
+    assert mgr.get("b") is None
+    assert mgr.get("c") is not None and mgr.get("d") is not None
+    assert len(mgr) == 2
+
+
+# -- pipeline ----------------------------------------------------------------
+
+
+class StubAnalysis:
+    """Just enough of AnalysisEngine for the pipeline machinery."""
+
+    class backend:
+        name = "stub-model"
+
+    def __init__(self, fail=False):
+        self.fail = fail
+        self.questions: list[tuple[str, str]] = []
+
+    def diagnose(self, question, context=None):
+        if self.fail:
+            raise RuntimeError("engine down")
+        self.questions.append((question, context))
+        return _verdict("critical")
+
+
+def test_pipeline_burst_to_verdict_with_coalescing():
+    clk = FakeClock()
+    analysis = StubAnalysis()
+    pipe = DiagnosisPipeline(
+        analysis,
+        DiagnosisConfig(burst_threshold=2, window_s=60, cooldown_s=0),
+        clock=clk)
+    for reason in ("BackOff", "BackOff", "OOMKilling", "OOMKilling"):
+        pipe.offer(EventInfo(type="Warning", reason=reason, message="m"))
+        clk.tick(1)
+    assert pipe.triggers_total == 2
+    assert pipe.run_pending() == 1             # two triggers, ONE query
+    assert pipe.queries_total == 1
+    question, context = analysis.questions[0]
+    assert "BackOff" in question and "OOMKilling" in question
+    assert "BackOff: m" in context             # events reached the prompt
+    entry = pipe.store.snapshot()[0]
+    assert entry["verdict"]["severity"] == "critical"
+    assert entry["model"] == "stub-model"
+    assert entry["lag_ms"] >= 0
+
+
+def test_pipeline_normal_events_feed_context_not_bursts():
+    pipe = DiagnosisPipeline(
+        StubAnalysis(), DiagnosisConfig(burst_threshold=1), clock=FakeClock())
+    pipe.offer(EventInfo(type="Normal", reason="Pulled", message="image"))
+    assert pipe.triggers_total == 0 and len(pipe.context) == 1
+
+
+def test_pipeline_survives_diagnose_errors():
+    clk = FakeClock()
+    pipe = DiagnosisPipeline(
+        StubAnalysis(fail=True),
+        DiagnosisConfig(burst_threshold=1, cooldown_s=0), clock=clk)
+    pipe.offer(EventInfo(type="Warning", reason="Failed", message="m"))
+    assert pipe.run_pending() == 0
+    assert pipe.errors_total == 1 and len(pipe.store) == 0
+
+
+def test_event_handler_formats_and_counts():
+    text = DiagnosisEventHandler.format_event(EventInfo(
+        type="Warning", reason="BackOff", message="restarting",
+        source="kubelet", count=4))
+    assert text == "BackOff: restarting (source kubelet) x4"
+
+    class Pod:
+        namespace, name, phase = "default", "web-0", "CrashLoopBackOff"
+
+    pipe = DiagnosisPipeline(StubAnalysis(), DiagnosisConfig(),
+                             clock=FakeClock())
+    pipe.handler.on_pod_update("MODIFIED", Pod())
+    pipe.handler.on_pod_update("MODIFIED", type("P", (), {"phase": "Running"}))
+    assert len(pipe.context) == 1
+    assert "default/web-0 phase=CrashLoopBackOff" in pipe.context.assemble()
+
+
+# -- synthetic crash-loop e2e ------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def diagnosis_server():
+    cfg = Config()
+    cfg.llm.provider = "template"
+    cfg.diagnosis.burst_threshold = 3
+    cfg.diagnosis.window_s = 60.0
+    cfg.diagnosis.cooldown_s = 0.0
+    srv = build_server(cfg, backend=seed_demo_cluster(FakeCluster()))
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}{path}", timeout=30) as r:
+        body = r.read().decode()
+        return (json.loads(body) if r.headers["Content-Type"].startswith(
+            "application/json") else body)
+
+
+def _post(srv, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def test_crash_loop_burst_lands_in_api_and_metrics(diagnosis_server):
+    """The acceptance path: fake watcher events → burst → constrained
+    verdict → GET /api/v1/diagnoses + /metrics gauges."""
+    srv = diagnosis_server
+    for i in range(4):
+        srv.diagnosis.handler.on_event(EventInfo(
+            type="Warning", reason="BackOff",
+            message=f"Back-off restarting failed container web (try {i})",
+            source="kubelet"))
+    deadline = time.monotonic() + 10
+    payload = _get(srv, "/api/v1/diagnoses")
+    while payload["count"] == 0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+        payload = _get(srv, "/api/v1/diagnoses")
+    assert payload["status"] == "success" and payload["count"] >= 1
+    entry = payload["diagnoses"][0]
+    verdict = entry["verdict"]
+    assert set(verdict) == {"severity", "component", "root_cause",
+                            "recommendation", "confidence"}
+    assert verdict["root_cause"]
+    assert "BackOff" in entry["trigger"]
+    assert payload["verdicts_total"][verdict["severity"]] >= 1
+    assert payload["pipeline"]["queries"] >= 1
+
+    metrics = _get(srv, "/metrics")
+    sev = verdict["severity"]
+    assert (f'k8s_llm_monitor_diagnosis_verdicts_total{{severity="{sev}"}}'
+            in metrics)
+    assert "k8s_llm_monitor_diagnosis_pipeline_lag_ms" in metrics
+    assert "k8s_llm_monitor_diagnosis_triggers_total" in metrics
+
+
+def test_diagnoses_limit_param_and_validation(diagnosis_server):
+    srv = diagnosis_server
+    payload = _get(srv, "/api/v1/diagnoses?limit=1")
+    assert len(payload["diagnoses"]) <= 1
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _get(srv, "/api/v1/diagnoses?limit=abc")
+    assert err.value.code == 400
+
+
+def test_session_queries_over_http(diagnosis_server):
+    srv = diagnosis_server
+    p1 = _post(srv, "/api/v1/query",
+               {"question": "what is wrong?", "session_id": ""})
+    sid = p1["result"]["session_id"]
+    assert p1["result"]["session_created"] and p1["result"]["turn"] == 1
+    p2 = _post(srv, "/api/v1/query",
+               {"question": "and the fix?", "session_id": sid})
+    assert p2["result"]["session_id"] == sid
+    assert p2["result"]["turn"] == 2 and not p2["result"]["session_created"]
+    plain = _post(srv, "/api/v1/query", {"question": "ok?"})
+    assert "session_id" not in plain["result"]
+
+
+def test_analyze_root_cause_includes_verdict(diagnosis_server):
+    srv = diagnosis_server
+    resp = _post(srv, "/api/v1/analyze", {
+        "type": "root_cause",
+        "parameters": {"target": "default/web", "symptom": "crashloop"}})
+    verdict = resp["result"]["verdict"]
+    assert verdict["severity"] in ("info", "warning", "critical")
+    assert verdict["root_cause"]

@@ -305,9 +305,11 @@ def test_engine_bounded_sampling_reproducible(params):
         res = eng.generate(prompts, sp)
         assert all(0 <= t < CFG.vocab_size
                    for r in res for t in r.token_ids)
-        # White-box: the bounded variant actually compiled.
-        assert any(sampled and bounded
-                   for _, sampled, bounded in eng._decode_cache)
+        # White-box: the bounded variant actually compiled.  Decode keys
+        # are (n_steps, sampled, bounded, constrained); spec programs use
+        # ("spec", ...) keys.
+        assert any(key[1] and key[2]
+                   for key in eng._decode_cache if key[0] != "spec")
         outs.append([r.token_ids for r in res])
     assert outs[0] == outs[1]
 

@@ -276,6 +276,84 @@ def test_raw_lock_line_suppression():
     assert lint(src, "raw-lock") == []
 
 
+# -- astlint: unconstrained-model-parse --------------------------------------
+
+
+def test_unconstrained_parse_flags_backend_classes():
+    src = """
+    import json
+
+    class MyBackend:
+        def generate(self, prompt):
+            raw = self._call(prompt)
+            return json.loads(raw)
+    """
+    assert len(lint(src, "unconstrained-model-parse")) == 1
+
+
+def test_unconstrained_parse_flags_model_output_markers():
+    src = """
+    from json import loads
+
+    def handle(answer_text):
+        verdict = loads(answer_text)
+        return verdict
+    """
+    assert len(lint(src, "unconstrained-model-parse")) == 1
+
+
+def test_unconstrained_parse_ignores_request_bodies_and_non_llm():
+    src = """
+    import json
+
+    class KubeRestBackend:  # no generate(): not an LLM adapter
+        def list_pods(self, raw):
+            return json.loads(raw)
+
+    def _read_json(handler):
+        raw = handler.rfile.read(10)
+        return json.loads(raw)
+    """
+    assert lint(src, "unconstrained-model-parse") == []
+
+
+def test_unconstrained_parse_exempts_grammar_module():
+    src = textwrap.dedent("""
+    import json
+
+    def parse_verdict(answer):
+        return json.loads(answer)
+    """)
+    findings = astlint.lint_source(src, path="diagnosis/grammar.py")
+    assert [f for f in findings
+            if f.rule == "unconstrained-model-parse"] == []
+    findings = astlint.lint_source(src, path="monitor/analysis.py")
+    assert len([f for f in findings
+                if f.rule == "unconstrained-model-parse"]) == 1
+
+
+def test_unconstrained_parse_line_suppression():
+    src = """
+    import json
+
+    class CompatBackend:
+        def generate(self, prompt):
+            data = json.loads(self._post(prompt))  # graftcheck: disable=unconstrained-model-parse -- envelope
+            return data["choices"][0]
+    """
+    assert lint(src, "unconstrained-model-parse") == []
+
+
+def test_unconstrained_parse_sees_through_strip_chains():
+    src = """
+    import json
+
+    def f(completion):
+        return json.loads(completion.strip())
+    """
+    assert len(lint(src, "unconstrained-model-parse")) == 1
+
+
 # -- astlint: suppressions + parse errors ------------------------------------
 
 

@@ -1,0 +1,212 @@
+"""Grammar compiler units: JSON schema → char DFA → token FSM.
+
+Pure-CPU tests for diagnosis/grammar.py: the regex-AST construction,
+determinization + dead-end pruning, the byte-tokenizer lift, and the
+Verdict grammar's render/parse round trip.  The engine-level property
+(every *sampled* sequence parses) lives in test_diagnosis.py.
+"""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from k8s_llm_monitor_tpu.diagnosis.grammar import (
+    VERDICT_SCHEMA, CharDFA, GrammarError, TokenFSM, compile_schema,
+    parse_verdict, render_verdict, token_fsm, verdict_dfa, verdict_fsm)
+from k8s_llm_monitor_tpu.utils.tokenizer import ByteTokenizer
+
+
+def encode_chars(text: str) -> list[int]:
+    """The ByteTokenizer char→token lift the FSM is built against."""
+    return [ord(c) + 3 for c in text]
+
+
+# -- schema → char DFA -------------------------------------------------------
+
+
+def test_enum_dfa_matches_exactly():
+    dfa = compile_schema({"enum": ["info", "warning", "critical"]})
+    assert dfa.matches('"info"')
+    assert dfa.matches('"critical"')
+    assert not dfa.matches('"INFO"')
+    assert not dfa.matches('"inf"')
+    assert not dfa.matches('"info" ')
+    assert not dfa.matches("info")
+
+
+def test_string_dfa_enforces_length_and_charset():
+    dfa = compile_schema({"type": "string", "minLength": 2, "maxLength": 4})
+    assert dfa.matches('"ab"')
+    assert dfa.matches('"abcd"')
+    assert not dfa.matches('"a"')        # below minLength
+    assert not dfa.matches('"abcde"')    # above maxLength
+    assert not dfa.matches('"a\\"b"')    # escapes are outside the charset
+    assert not dfa.matches('"a\nb"')
+
+
+def test_number_dfa_bounded_decimal():
+    dfa = compile_schema({"type": "number"})
+    for good in ("0", "7", "123456", "-3", "0.25", "-12.3456"):
+        assert dfa.matches(good), good
+    for bad in ("00", "1.", ".5", "1e3", "-", "1.23456", "1234567"):
+        assert not dfa.matches(bad), bad
+
+
+def test_boolean_integer_array_dfas():
+    assert compile_schema({"type": "boolean"}).matches("true")
+    assert not compile_schema({"type": "boolean"}).matches("True")
+    ints = compile_schema({"type": "integer"})
+    assert ints.matches("-42") and not ints.matches("007")
+    arr = compile_schema({"type": "array",
+                          "items": {"type": "integer"}, "maxItems": 2})
+    assert arr.matches("[]") and arr.matches("[1,2]")
+    assert not arr.matches("[1,2,3]") and not arr.matches("[1,]")
+
+
+def test_object_dfa_fixed_key_order():
+    dfa = compile_schema({
+        "type": "object",
+        "properties": {"a": {"type": "integer"},
+                       "b": {"enum": ["x", "y"]}},
+        "required": ["a", "b"],
+    })
+    assert dfa.matches('{"a":1,"b":"x"}')
+    # Canonical form: no whitespace, declared key order, no omissions.
+    assert not dfa.matches('{"b":"x","a":1}')
+    assert not dfa.matches('{"a": 1,"b":"x"}')
+    assert not dfa.matches('{"a":1}')
+
+
+def test_unsupported_schemas_raise():
+    with pytest.raises(GrammarError):
+        compile_schema({"type": "object", "properties": {}})
+    with pytest.raises(GrammarError):
+        compile_schema({"type": "null"})
+    with pytest.raises(GrammarError):
+        compile_schema({"enum": [1, 2]})
+    with pytest.raises(GrammarError):
+        compile_schema({"type": "string", "maxLength": 0})
+
+
+def test_max_path_len_bounded_and_unbounded():
+    dfa = compile_schema({"enum": ["no", "yes"]})
+    assert dfa.max_path_len() == len('"yes"')
+    looped = CharDFA(trans=[{"a": 0}], accept=[True])
+    assert looped.max_path_len() == -1
+
+
+# -- token lift --------------------------------------------------------------
+
+
+def test_token_fsm_free_row_and_start():
+    fsm = token_fsm(compile_schema({"enum": ["ok"]}))
+    assert fsm.start == 1
+    assert np.all(fsm.trans[0] == 0)          # FREE state allows everything
+    assert fsm.step(0, 123) == 0              # ... and self-loops
+    assert fsm.max_len == len('"ok"') + 1     # chars + EOS
+
+
+def test_token_fsm_walk_accepts_and_rejects():
+    fsm = token_fsm(compile_schema({"enum": ["ok", "bad"]}))
+    state = fsm.walk(encode_chars('"ok"'))
+    assert state >= 1 and fsm.accept[state]
+    # Accept state: only EOS self-loops; any other token is disallowed.
+    assert fsm.step(state, fsm.eos_id) == state
+    allowed = fsm.allowed(state)
+    assert allowed[fsm.eos_id] and allowed.sum() == 1
+    assert fsm.walk(encode_chars('"nope"')) == -1
+    # walk resumes from an explicit state (preemption re-admission path).
+    mid = fsm.walk(encode_chars('"o'))
+    assert fsm.walk(encode_chars('k"'), state=mid) == state
+
+
+def test_token_fsm_rejects_out_of_vocab():
+    fsm = token_fsm(compile_schema({"enum": ["ok"]}))
+    assert fsm.step(fsm.start, fsm.vocab_size + 5) == -1
+    with pytest.raises(GrammarError):
+        token_fsm(compile_schema({"enum": ["ok"]}), vocab_size=10)
+
+
+def test_from_table_validates_shape_and_free_row():
+    trans = np.zeros((3, 8), dtype=np.int32)
+    trans[1:] = -1
+    trans[1, 2] = 2
+    fsm = TokenFSM.from_table(trans, start=1,
+                              accept=np.array([False, False, True]),
+                              eos_id=2)
+    assert fsm.n_states == 3
+    with pytest.raises(GrammarError):
+        TokenFSM.from_table(trans, start=0, accept=[True] * 3, eos_id=2)
+    bad = trans.copy()
+    bad[0, 3] = -1
+    with pytest.raises(GrammarError):
+        TokenFSM.from_table(bad, start=1, accept=[False] * 3, eos_id=2)
+
+
+# -- the Verdict grammar -----------------------------------------------------
+
+
+def test_render_verdict_round_trips():
+    text = render_verdict("critical", "default/web",
+                          "container OOMKilled under memory pressure",
+                          "raise the memory limit", 0.87)
+    v = parse_verdict(text)
+    assert v["severity"] == "critical"
+    assert v["component"] == "default/web"
+    assert v["confidence"] == 0.87
+
+
+def test_render_verdict_clamps_hostile_fields():
+    text = render_verdict("catastrophic", 'x" * 99', "a\nb\"c\\d" + "e" * 500,
+                          "", 7.5)
+    v = parse_verdict(text)
+    assert v["severity"] == "warning"          # invalid severity coerced
+    assert '"' not in v["component"]
+    assert len(v["root_cause"]) <= 160
+    assert v["recommendation"] == "n/a"        # empty field backfilled
+    assert v["confidence"] == 1.0              # clamped into [0, 1]
+
+
+def test_parse_verdict_rejects_almost_json():
+    good = render_verdict("info", "c", "r", "fix", 0.5)
+    for bad in (good[:-1], good.replace(":", ": ", 1),
+                '{"severity":"info"}', "not json at all",
+                good.replace('"info"', '"urgent"')):
+        with pytest.raises(GrammarError):
+            parse_verdict(bad)
+    # Leading/trailing whitespace is stripped before validation.
+    assert parse_verdict("  " + good + "\n")["severity"] == "info"
+
+
+def test_verdict_fsm_cached_and_sized_for_byte_vocab():
+    tok = ByteTokenizer()
+    fsm = verdict_fsm(eos_id=tok.eos_id)
+    assert fsm is verdict_fsm(eos_id=tok.eos_id)     # cache hit
+    assert fsm.vocab_size == ByteTokenizer.vocab_size
+    assert fsm.max_len == verdict_dfa().max_path_len() + 1
+    # Every canonical rendering must thread the token FSM to acceptance.
+    text = render_verdict("warning", "kube-system/dns", "lookup timeouts",
+                          "restart coredns", 0.4)
+    state = fsm.walk(encode_chars(text))
+    assert state >= 1 and fsm.accept[state]
+    assert len(text) + 1 <= fsm.max_len
+
+
+def test_verdict_grammar_fuzz_renderings_always_parse():
+    rng = np.random.default_rng(0)
+    alphabet = np.array(list(
+        "abc XYZ123/.-_:\"\\\n\t{}[]üé" + chr(7)))
+    severities = ["info", "warning", "critical", "fatal", ""]
+    for i in range(200):
+        fields = ["".join(rng.choice(alphabet, size=rng.integers(0, 80)))
+                  for _ in range(3)]
+        text = render_verdict(severities[i % len(severities)], fields[0],
+                              fields[1], fields[2],
+                              float(rng.normal(0.5, 2.0)))
+        v = parse_verdict(text)  # must never raise
+        assert set(v) == {"severity", "component", "root_cause",
+                          "recommendation", "confidence"}
+        assert json.loads(text) == v
