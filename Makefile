@@ -5,10 +5,10 @@ PY ?= python
 TEST_ENV = env PYTHONPATH= JAX_PLATFORMS=cpu
 SHELL := /bin/bash    # tier1 uses pipefail/PIPESTATUS
 
-.PHONY: run run-agent run-scheduler demo test test-fast tier1 chaos \
-        chaos-lifecycle chaos-fleet diagnose-e2e bench bench-decode \
-        bench-fleet dryrun smoke preflight deploy-agent docker docker-agent \
-        docker-scheduler lint lint-trace clean
+.PHONY: run run-agent run-scheduler demo test test-fast tier1 tier1-mesh \
+        chaos chaos-lifecycle chaos-fleet diagnose-e2e bench bench-decode \
+        bench-fleet bench-mesh dryrun smoke preflight deploy-agent docker \
+        docker-agent docker-scheduler lint lint-trace clean
 
 run:
 	$(PY) -m k8s_llm_monitor_tpu.cmd.server --cluster fake --port 8081
@@ -34,13 +34,22 @@ test-fast:          # monitor plane only (no jax compiles)
 
 tier1:              # the driver's verify gate, verbatim (ROADMAP.md)
 	set -o pipefail; rm -f /tmp/_t1.log; \
-	timeout -k 10 870 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
+	timeout -k 10 1350 env JAX_PLATFORMS=cpu $(PY) -m pytest tests/ -q \
 	  -m 'not slow' --continue-on-collection-errors -p no:cacheprovider \
 	  -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; \
 	rc=$${PIPESTATUS[0]}; \
 	echo DOTS_PASSED=$$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$$' /tmp/_t1.log \
 	  | tr -cd . | wc -c); \
 	exit $$rc
+
+# Mesh acceptance: TP-8 parity + SpecLayout + traceguard mesh path on the
+# simulated 8-device CPU mesh, with lock discipline checked.  (conftest.py
+# forces the 8-device XLA flag; set here too so the leg is self-contained.)
+tier1-mesh:
+	$(TEST_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  K8SLLM_LOCKCHECK=1 \
+	  $(PY) -m pytest tests/test_sharding.py tests/test_spec_decode.py -q \
+	  -p no:cacheprovider
 
 chaos:              # fault-injection resilience suite (docs/resilience.md)
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m chaos -p no:cacheprovider
@@ -74,6 +83,17 @@ bench-decode:       # fused-vs-fallback decode microbench + phase attribution
 bench-fleet:        # CPU fleet smoke: 1-vs-2 replicas, hedged tail latency
 	$(TEST_ENV) BENCH_FLEET_ONLY=1 BENCH_MODEL=tiny \
 	  $(PY) bench.py | tee fleet-bench.json
+
+# TP-mesh serving dryrun: p50/p99 TTFT + tok/s through one tensor-parallel
+# engine on a forced 8-host-device CPU mesh (JSON flagged mesh_dryrun).
+# The measured leg runs inside plain `make bench` on real multi-chip
+# hardware and supersedes the perchip_equiv_* arithmetic.
+bench-mesh:
+	$(TEST_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+	  BENCH_MESH_ONLY=1 BENCH_MODEL=tiny BENCH_QUANT=none \
+	  BENCH_MESH_CONCURRENCY=12 BENCH_MESH_PROMPT_LEN=48 \
+	  BENCH_MESH_MAX_TOKENS=12 BENCH_MESH_SLOTS=8 \
+	  $(PY) bench.py | tee mesh-bench.json
 
 smoke:              # boot server + 20-check live API suite
 	$(TEST_ENV) bash scripts/smoke.sh

@@ -19,8 +19,14 @@ in int8; the bf16 intermediate would not fit either) — the arithmetic,
 shapes, and HBM traffic match the real checkpoint exactly.  Honest context:
 the 500 ms SLO is defined for v5e-8 (8 chips, BASELINE.md config #4); this
 bench drives ONE chip with the full 100-request burst, i.e. 8x the SLO's
-per-chip load.  The per-chip-equivalent leg (100/8 -> 12 concurrent) is
-reported in extras as the apples-to-apples number.
+per-chip load.  When more than one device is visible, the **mesh leg**
+(``mesh_leg``) runs ONE tensor-parallel engine over all of them and reports
+measured ``mesh_p50_ttft_ms`` / ``mesh_p99_ttft_ms`` / ``mesh_tok_s`` — the
+apples-to-apples multi-chip numbers.  The old per-chip-equivalent leg
+(100/8 -> 12 concurrent through one chip) remains in extras but is
+informational only.  ``BENCH_MESH_ONLY=1`` (``make bench-mesh``) runs just
+the mesh leg; off-TPU it executes on the forced-host-device mesh and is
+flagged ``mesh_dryrun``.
 
 A persistent XLA compilation cache (.jax_cache/) makes warm boots cheap;
 the bench reports its warmup time and whether the cache was already
@@ -184,6 +190,108 @@ def fleet_leg(cfg, params) -> dict:
     }
 
 
+def mesh_leg(cfg, params) -> dict:
+    """ICI-sharded serving leg: ONE tensor-parallel engine over every local
+    device (weights column/row-sharded, KV pages head-sharded — parallel/
+    sharding.py), measured p50/p99 TTFT and throughput.  This is the
+    multi-chip number: it replaces the old per-chip-equivalence arithmetic
+    (burst/8 through one chip), which modeled neither the collectives nor
+    the shared-KV-pool batching dynamics of a real slice.  Off-TPU the same
+    leg runs on the forced-host-device mesh and is annotated as a dryrun —
+    program structure and parity are exercised; the timings are not ICI.
+    """
+    import numpy as np
+    import jax
+
+    from k8s_llm_monitor_tpu.parallel.mesh import MeshConfig, create_mesh
+    from k8s_llm_monitor_tpu.serving.engine import (
+        EngineConfig,
+        GenerationRequest,
+        InferenceEngine,
+        SamplingParams,
+    )
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        raise RuntimeError(
+            "mesh leg needs >= 2 devices (set XLA_FLAGS="
+            "--xla_force_host_platform_device_count=8 for a CPU dryrun)")
+    mesh = create_mesh(MeshConfig(model=len(devs)))
+    dryrun = devs[0].platform != "tpu"
+
+    m_len = int(os.environ.get("BENCH_MESH_PROMPT_LEN",
+                               os.environ.get("BENCH_PROMPT_LEN", "192")))
+    m_gen = int(os.environ.get("BENCH_MESH_MAX_TOKENS",
+                               os.environ.get("BENCH_MAX_TOKENS", "48")))
+    m_n = int(os.environ.get("BENCH_MESH_CONCURRENCY",
+                             os.environ.get("BENCH_CONCURRENCY", "100")))
+    m_slots = int(os.environ.get("BENCH_MESH_SLOTS", "32"))
+    cap = m_len + m_gen + 1
+    bucket = int(np.ceil(m_len / 64) * 64)
+    ecfg = EngineConfig(
+        max_slots=m_slots,
+        num_blocks=m_slots * ((cap + 15) // 16) + 16,
+        block_size=16,
+        max_blocks_per_seq=(cap + 15) // 16,
+        prefill_buckets=(bucket,),
+        max_prefills_per_step=min(16, m_slots),
+        max_admission_rounds=8,
+        decode_steps_per_iter=int(os.environ.get("BENCH_DECODE_STEPS", "8")),
+    )
+    eng = InferenceEngine(cfg, params, ecfg, eos_id=-1, mesh=mesh)
+    rng = np.random.default_rng(3)
+
+    def m_prompt() -> list[int]:
+        return [int(t) for t in
+                rng.integers(4, cfg.vocab_size - 4, size=m_len)]
+
+    # Warm the admission-lane ladder so measured TTFT excludes compiles.
+    log(f"mesh leg: {len(devs)}x {devs[0].device_kind} "
+        f"({'DRYRUN: host devices, not ICI' if dryrun else 'measured'}); "
+        f"warming compiled shapes...")
+    w = ecfg.max_prefills_per_step
+    while w >= 1:
+        eng.generate([m_prompt() for _ in range(w)],
+                     SamplingParams(max_tokens=4))
+        w //= 2
+
+    t0 = time.monotonic()
+    for i in range(m_n):
+        eng.submit(GenerationRequest(
+            request_id=f"mesh-{i}", prompt_ids=m_prompt(),
+            sampling=SamplingParams(max_tokens=m_gen)))
+    while eng.has_work:
+        eng.step()
+    wall = time.monotonic() - t0
+    res = [eng.poll(f"mesh-{i}") for i in range(m_n)]
+    assert all(r is not None and r.finish_reason != "error" for r in res)
+    t = np.array(sorted(r.ttft_s for r in res))
+    p50_ms = float(np.percentile(t, 50)) * 1e3
+    p99_ms = float(np.percentile(t, 99)) * 1e3
+    tok_s = sum(len(r.token_ids) for r in res) / wall
+
+    coll_share = 0.0
+    try:
+        eng.profile_decode_phases()
+        coll_share = eng.decode_collective_share
+    except Exception as exc:  # noqa: BLE001 — extras never fail the bench
+        log(f"mesh collective-share probe skipped: {exc}")
+
+    log(f"mesh ({len(devs)} devices, {m_n} concurrent): "
+        f"p50 TTFT {p50_ms:.1f} ms, p99 {p99_ms:.1f} ms, "
+        f"{tok_s:.1f} tok/s, est collective share {coll_share:.0%}")
+    return {
+        "mesh_p50_ttft_ms": round(p50_ms, 2),
+        "mesh_p99_ttft_ms": round(p99_ms, 2),
+        "mesh_tok_s": round(tok_s, 1),
+        "mesh_devices": len(devs),
+        "mesh_device_kind": devs[0].device_kind,
+        "mesh_concurrency": m_n,
+        "mesh_dryrun": dryrun,
+        "mesh_collective_share_est": round(coll_share, 4),
+    }
+
+
 def main() -> None:
     t0 = time.monotonic()
     cache_was_warm = CACHE_DIR.is_dir() and any(CACHE_DIR.iterdir())
@@ -236,6 +344,19 @@ def main() -> None:
         print(json.dumps({
             "metric": "fleet_2replica_tok_s",
             "value": stats.get("fleet_2replica_tok_s", 0.0),
+            "unit": "tok/s",
+            "extras": {"model": model_name, "platform": dev.platform,
+                       **stats},
+        }))
+        return
+
+    if os.environ.get("BENCH_MESH_ONLY", "0") == "1":
+        # `make bench-mesh`: just the TP-mesh leg.  Dryrun on the forced
+        # 8-host-device CPU mesh in CI; measured on a real slice.
+        stats = mesh_leg(cfg, params)
+        print(json.dumps({
+            "metric": "mesh_tok_s",
+            "value": stats.get("mesh_tok_s", 0.0),
             "unit": "tok/s",
             "extras": {"model": model_name, "platform": dev.platform,
                        **stats},
@@ -338,7 +459,8 @@ def main() -> None:
         pcres = [eng.poll(f"pc-{i}") for i in range(n_pc)]
         assert all(r is not None and r.finish_reason != "error" for r in pcres)
         perchip_p50_ms, perchip_p99_ms = ttft_pcts(pcres)
-        log(f"per-chip-equivalent ({n_pc} concurrent): "
+        log(f"per-chip-equivalent ({n_pc} concurrent, informational — "
+            f"see mesh leg for the measured multi-chip number): "
             f"p50 TTFT {perchip_p50_ms:.1f} ms, p99 {perchip_p99_ms:.1f} ms")
     except Exception as exc:  # noqa: BLE001 — extras never fail the bench
         log(f"per-chip leg skipped: {exc}")
@@ -583,6 +705,17 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001
         log(f"decode saturation leg skipped: {exc}")
     del eng  # free the headline KV pool before the long-prompt engine
+
+    # --- mesh leg: TP over every local device — the SLO's actual v5e-8
+    # shape, measured.  Supersedes the per-chip-equivalence arithmetic
+    # above (kept in extras as informational only).  Runs after the
+    # headline engine is freed so the sharded weight copies fit. ---------
+    mesh_stats = {}
+    if len(jax.devices()) > 1 and os.environ.get("BENCH_MESH", "1") == "1":
+        try:
+            mesh_stats = mesh_leg(cfg, params)
+        except Exception as exc:  # noqa: BLE001 — extras never fail the bench
+            log(f"mesh leg skipped: {exc}")
 
     # --- W8A8 leg: dynamic per-token activation int8 on top of the int8
     # weights — prefill runs s8 x s8 on the MXU int8 path (measured ~203
@@ -1311,8 +1444,13 @@ def main() -> None:
     if query_e2e_ms is not None:
         extras["query_e2e_ms"] = round(query_e2e_ms, 2)
     if perchip_p50_ms is not None:
+        # Informational only: burst/8 through one chip models neither the
+        # ICI collectives nor the shared-KV-pool batching of a real slice.
+        # The measured multi-chip numbers are the mesh_* keys below.
         extras["perchip_equiv_p50_ttft_ms"] = round(perchip_p50_ms, 2)
         extras["perchip_equiv_p99_ttft_ms"] = round(perchip_p99_ms, 2)
+        extras["perchip_equiv_informational"] = True
+    extras.update(mesh_stats)
     if shared_p50_ms is not None:
         extras["shared_prefix_p50_ttft_ms"] = round(shared_p50_ms, 2)
         extras["shared_prefix_p99_ttft_ms"] = round(shared_p99_ms, 2)

@@ -41,9 +41,9 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--trace", action="store_true",
                         help="also run the trace-time guards (jit-compiles "
                              "a tiny engine on CPU; slower)")
-    parser.add_argument("--trace-paths", default="gather,fused",
+    parser.add_argument("--trace-paths", default="gather,fused,mesh",
                         help="comma-separated decode paths for --trace "
-                             "(default: gather,fused)")
+                             "(default: gather,fused,mesh)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the AST rules and exit")
     args = parser.parse_args(argv)

@@ -39,25 +39,44 @@ FORBIDDEN_PRIMITIVES = frozenset({
 
 #: Decode paths the guard exercises by default.  "fused" runs the Pallas
 #: kernel in interpreter mode off-TPU — same trace, same jaxpr, no TPU
-#: needed; "gather" is the XLA fallback (and the numerics oracle).
-DEFAULT_PATHS = ("gather", "fused")
+#: needed; "gather" is the XLA fallback (and the numerics oracle); "mesh"
+#: builds the engine under a GSPMD mesh spanning every local device (the
+#: forced-host 8-device CPU mesh in CI) so the SHARDED fused-decode and
+#: chunk-prefill programs are gated too — same zero-recompile and
+#: donation-rebinding assertions, now over collective-aware programs.
+DEFAULT_PATHS = ("gather", "fused", "mesh")
 
 
 def force_cpu() -> None:
     """Pin jax to CPU before any backend initializes (the environment's
     sitecustomize may otherwise route to a tunneled TPU — see
-    tests/conftest.py for the same dance)."""
+    tests/conftest.py for the same dance).  Also forces the 8-device host
+    platform so the "mesh" path has a real axis to shard over; no-op if
+    jax already initialized (the mesh path then uses whatever device
+    count exists)."""
     os.environ["JAX_PLATFORMS"] = "cpu"
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
     import jax
 
     jax.config.update("jax_platforms", "cpu")
 
 
-def _tiny_cfg(fused: bool):
+def _tiny_cfg(fused: bool, mesh_tp: int = 0):
     """Model configs mirroring tests/test_fused_decode.py: one fails the
-    Mosaic 128-lane gate (gather-only), one passes it (KVH*D = 2*64)."""
+    Mosaic 128-lane gate (gather-only), one passes it (KVH*D = 2*64).
+    ``mesh_tp`` > 0 selects the TP-shardable config: 8 heads / 8 KV heads
+    so every power-of-two device count up to 8 gets head-aligned KV page
+    shards (parallel/sharding.py:SpecLayout.kv_pages)."""
     from k8s_llm_monitor_tpu.models.config import ModelConfig
 
+    if mesh_tp:
+        return ModelConfig(name="tg-mesh", vocab_size=256, hidden_size=64,
+                           intermediate_size=128, num_layers=2, num_heads=8,
+                           num_kv_heads=8, dtype="float32",
+                           rope_theta=10_000.0)
     if fused:
         return ModelConfig(name="tg-fused", vocab_size=128, hidden_size=256,
                            intermediate_size=256, num_layers=1, num_heads=4,
@@ -93,14 +112,30 @@ def build_engine(decode_path: str = "gather", seed: int = 0):
     cache off (a second same-prefix prompt would switch admission to the
     chunked program — a *legitimate* new compile the guard must not count),
     speculation off, two buckets.  A toy grammar is installed so the
-    constrained decode/prefill programs join the gated set."""
+    constrained decode/prefill programs join the gated set.
+
+    ``decode_path="mesh"`` builds the SHARDED engine: a GSPMD mesh over
+    every local device (TP on ``model``), weights and KV pages device-put
+    with the SpecLayout-derived NamedShardings, attention on the XLA
+    gather oracle (GSPMD partitions it from the annotations) — the same
+    programs the v5e-8 serving config runs, minus real ICI."""
     import jax
 
     from k8s_llm_monitor_tpu.models import llama
     from k8s_llm_monitor_tpu.ops.attention import select_decode_impl
     from k8s_llm_monitor_tpu.serving.engine import EngineConfig, InferenceEngine
 
-    cfg = _tiny_cfg(fused=decode_path == "fused")
+    mesh = None
+    if decode_path == "mesh":
+        from k8s_llm_monitor_tpu.parallel.mesh import MeshConfig, create_mesh
+
+        tp = len(jax.devices())
+        mesh = create_mesh(MeshConfig(model=tp))
+        cfg = _tiny_cfg(fused=False, mesh_tp=tp)
+        impl = select_decode_impl(cfg=cfg, mesh=mesh, mode="gather")
+    else:
+        cfg = _tiny_cfg(fused=decode_path == "fused")
+        impl = select_decode_impl(cfg=cfg, mode=decode_path)
     params = llama.init_params(jax.random.PRNGKey(seed), cfg)
     ec = EngineConfig(
         max_slots=4, num_blocks=64, block_size=8, max_blocks_per_seq=8,
@@ -108,9 +143,8 @@ def build_engine(decode_path: str = "gather", seed: int = 0):
         max_admission_rounds=2, decode_steps_per_iter=4, max_inflight=2,
         spec_k=0, prefix_cache_entries=0, sample_topk_cap=8,
     )
-    impl = select_decode_impl(cfg=cfg, mode=decode_path)
     engine = InferenceEngine(cfg, params, engine_cfg=ec, eos_id=-1,
-                             attn_impl=impl)
+                             attn_impl=impl, mesh=mesh)
     engine.set_grammar(_toy_fsm())
     return engine
 
@@ -330,15 +364,23 @@ def _drive(engine, prompt_len: int, greedy: bool, tag: int,
 def check_path(decode_path: str) -> PathReport:
     engine = build_engine(decode_path)
 
+    # prompt_len 40 > the top bucket (32): forces the chunk-round admission
+    # path, so the chunk-prefill programs (plain + FSM) are compiled in the
+    # warm pass and gated for zero recompiles in the repeat pass — on the
+    # mesh path these are the SHARDED chunk programs.
     def warm():
         _drive(engine, prompt_len=12, greedy=True, tag=1)
         _drive(engine, prompt_len=12, greedy=False, tag=2)
         _drive(engine, prompt_len=12, greedy=False, tag=5, constrained=True)
+        _drive(engine, prompt_len=40, greedy=True, tag=7)
+        _drive(engine, prompt_len=40, greedy=False, tag=8, constrained=True)
 
     def repeat():
         _drive(engine, prompt_len=12, greedy=True, tag=3)
         _drive(engine, prompt_len=12, greedy=False, tag=4)
         _drive(engine, prompt_len=12, greedy=False, tag=6, constrained=True)
+        _drive(engine, prompt_len=40, greedy=True, tag=9)
+        _drive(engine, prompt_len=40, greedy=False, tag=10, constrained=True)
 
     warm_c, warm_e = count_new_compiles(engine, warm)
     pages_before = engine.pages

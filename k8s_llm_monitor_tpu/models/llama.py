@@ -55,6 +55,16 @@ class KVPages(NamedTuple):
     relayout copy of every page array on every decode step (~4.6 GB/step
     for 8B at 2200 blocks — measured as 64 materialized reshapes in the
     compiled HLO, and most of the decode step time).
+
+    Mesh execution: the fused lane dim is kv-head-MAJOR (``reshape(KVH*D)``
+    of ``[..., KVH, D]``), so sharding it ``model``-ways when tp divides
+    KVH is exactly a per-chip contiguous slice of ``KVH/tp`` whole heads —
+    ``SpecLayout.kv_pages`` (parallel/sharding.py) relies on this, and it
+    is why head-sharded paged attention needs no resharding collective at
+    the page boundary.  The page/block axes are NEVER sharded: block ids
+    stay global (serving/kv_cache.py module docstring), every chip
+    scatters/gathers with the same block table, and the host allocator
+    stays mesh-agnostic.
     """
 
     k: list[jnp.ndarray]

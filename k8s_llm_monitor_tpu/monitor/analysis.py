@@ -411,7 +411,8 @@ class LocalEngineBackend(LLMBackend):
                 params,
                 EngineConfig(max_slots=tpu_cfg.max_batch,
                              num_blocks=tpu_cfg.kv_blocks,
-                             spec_k=tpu_cfg.spec_k),
+                             spec_k=tpu_cfg.spec_k,
+                             spec_min_accept=tpu_cfg.spec_min_accept),
                 tokenizer=tokenizer,
                 mesh=mesh,
             )

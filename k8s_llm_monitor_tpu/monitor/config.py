@@ -68,6 +68,11 @@ class TPULLMConfig:
     # the adaptive engine falls back to the fused path whenever measured
     # acceptance is below engine spec_min_accept anyway.
     spec_k: int = 0
+    # Acceptance floor for the per-request-class speculative kill-switch
+    # (serving/spec.py AcceptanceEMA): when a class's accepted-tokens-per-
+    # lane-round EMA drops below this, drafting auto-disables for that
+    # class (re-probing periodically).  Exported as `spec_accept_ema`.
+    spec_min_accept: float = 1.2
 
 
 @dataclass

@@ -40,6 +40,8 @@ class _Writer:
         for labels, value in samples:
             if isinstance(value, float) and math.isinf(value):
                 value = "+Inf" if value > 0 else "-Inf"
+            elif isinstance(value, float) and math.isnan(value):
+                value = "NaN"
             self.lines.append(f"{full}{labels} {value}")
 
     def render(self) -> str:
@@ -92,6 +94,35 @@ def _engine_metrics(w: _Writer, engine) -> None:
              "Active lane-rounds across spec verify forwards (divide "
              "spec_tokens by this for per-lane acceptance)",
              [("", engine.spec_lane_rounds)])
+    # Per-request-class accepted-length EMA (serving/spec.py:AcceptanceEMA):
+    # the signal behind the adaptive drafting kill-switch.  Absent until a
+    # class has a measurement — a missing class label means "never probed",
+    # not zero acceptance.
+    ema_fn = getattr(engine, "spec_accept_ema", None)
+    snap = ema_fn() if callable(ema_fn) else {}
+    if snap:
+        w.metric("spec_accept_ema", "gauge",
+                 "Accepted tokens per lane-round EMA, by request class; "
+                 "drafting auto-disables below the configured floor",
+                 [(f'{{class="{k}"}}', round(v, 4))
+                  for k, v in sorted(snap.items())])
+
+    # Mesh topology: one sample per axis of the serving mesh, so the
+    # dashboard can tell a TP-8 v5e slice from a single chip without
+    # scraping the deployment spec.  Off-mesh engines emit nothing.
+    mesh_fn = getattr(engine, "mesh_axes", None)
+    axes = mesh_fn() if callable(mesh_fn) else {}
+    if axes:
+        w.metric("mesh_axes", "gauge",
+                 "Serving mesh axis sizes (data/seq/model)",
+                 [(f'{{axis="{a}"}}', int(n))
+                  for a, n in sorted(axes.items())])
+        w.metric("engine_decode_collective_share", "gauge",
+                 "Estimated ICI (collective) share of a TP decode step, "
+                 "from the decode profile's byte model; 0 until "
+                 "profile_decode_phases() has run",
+                 [("", round(getattr(engine, "decode_collective_share",
+                                     0.0), 4))])
 
     # Decode-step phase attribution (fused fast-path observability).
     # attn/sample are populated by engine.profile_decode_phases() — a
@@ -291,12 +322,19 @@ def _diagnosis_metrics(w: _Writer, pipeline, backend) -> None:
         w.metric("diagnosis_context_events", "gauge",
                  "Cluster events held in the context ring buffer",
                  [("", len(pipeline.context))])
+    # Emitted UNCONDITIONALLY: the fleet router proxies replica /metrics,
+    # and a gauge that only the local-engine backend emits would silently
+    # mix populations across a scrape of mixed backends.  Backends that do
+    # not track the EMA (remote/openai/template, or a router with no
+    # engine) emit an explicit NaN marker instead of being absent, so
+    # dashboards can tell "not measured here" from "never scraped".
     overhead = getattr(backend, "constrained_decode_overhead_ms", None)
-    if overhead is not None:
-        w.metric("constrained_decode_overhead_ms", "gauge",
-                 "Per-token decode cost of FSM-constrained sampling vs "
-                 "free decoding (EMA delta; 0 until both paths observed)",
-                 [("", round(overhead, 4))])
+    w.metric("constrained_decode_overhead_ms", "gauge",
+             "Per-token decode cost of FSM-constrained sampling vs "
+             "free decoding (EMA delta; 0 until both paths observed; "
+             "NaN when this backend does not measure it)",
+             [("", round(overhead, 4) if overhead is not None
+               else float("nan"))])
 
 
 def _device_metrics(w: _Writer) -> None:

@@ -20,6 +20,29 @@ from jax.sharding import Mesh
 
 AXES = ("data", "seq", "model")
 
+# Approximate aggregate ICI bandwidth per chip (GB/s, all links, one
+# direction), keyed by substrings of jax Device.device_kind — the byte-model
+# input for the engine's per-step collective-time share estimate.  CPU and
+# unknown chips fall back to the v5e figure: the estimate is explicitly a
+# model, and on the forced-host dev mesh it is annotated as a dryrun.
+ICI_GBS = {
+    "v5 lite": 200.0,   # v5e: 4 links x 400 Gbps
+    "v5e": 200.0,
+    "v5p": 600.0,
+    "v4": 300.0,
+    "v6": 448.0,        # v6e (Trillium)
+}
+_ICI_GBS_DEFAULT = 200.0
+
+
+def ici_bandwidth_gbs(device_kind: str) -> float:
+    """Per-chip aggregate ICI bandwidth for ``device_kind`` (GB/s)."""
+    kind = device_kind.lower()
+    for key, gbs in ICI_GBS.items():
+        if key in kind:
+            return gbs
+    return _ICI_GBS_DEFAULT
+
 
 def init_multihost(coordinator: str | None = None,
                    num_processes: int | None = None,

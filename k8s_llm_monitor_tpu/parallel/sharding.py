@@ -8,18 +8,34 @@ Megatron-style tensor parallelism expressed as GSPMD annotations:
 XLA inserts the psum after row-parallel matmuls automatically from these
 annotations — there is no manual collective in the model code.
 
+The layout is factored two ways (SNIPPETS.md [2]/[3]):
+
+  * ``SpecLayout`` — a frozen dataclass with one method per parameter
+    *role* (embedding, column/row projection, expert stack, norm).  It is
+    the single place the axis names live; serving, tests, and the bench
+    all derive their ``NamedSharding``s from it.
+  * ``partition_rules()`` — the role methods bound to param-path regexes
+    (the ``match_partition_rules`` idiom), so a checkpoint pytree maps to
+    specs by name without the model code knowing about meshes.
+
 KV pages shard the kv-heads axis over ``model`` when the head count divides
 the TP degree.  For Llama-3-8B (8 KV heads) on v5e-8 that is exactly one KV
 head per chip.  When TP exceeds the KV head count (70B/72B: 8 KV heads on
 v5p-16), the kv-heads axis cannot be partitioned 16 ways — those configs
-replicate the KV pages across the model axis instead — ``kv_pages_partition_
-specs`` infers the choice from the pages' kv-heads axis and the mesh's
-``model`` axis size — trading HBM for a spec that compiles; attention
-Q-heads remain fully sharded either way.
+replicate the KV pages across the model axis instead (``SpecLayout.
+kv_pages`` infers the choice) — trading HBM for a spec that compiles;
+attention Q-heads remain fully sharded either way.
+
+Page tables and context lengths are NEVER sharded: block ids are global
+(serving/kv_cache.py allocates them host-side), every chip indexes the
+same table rows and reads its own head-slice of each page.  That is the
+invariant that lets ``BlockAllocator``/``PrefixCache`` stay mesh-agnostic.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import re
 from typing import Any
 
 import jax
@@ -28,80 +44,169 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from k8s_llm_monitor_tpu.models.config import ModelConfig
 from k8s_llm_monitor_tpu.models.llama import KVPages
 
-# Rules keyed by (parent, leaf) path suffix.
-_COL = {"q", "k", "v", "gate", "up", "lm_head"}   # kernel [in, out] -> shard out
-_ROW = {"o", "down"}                               # kernel [in, out] -> shard in
+
+@dataclasses.dataclass(frozen=True)
+class SpecLayout:
+    """Axis layout for tensor-parallel serving, one method per param role.
+
+    Frozen so a layout can key caches and be shared across engine builds;
+    instantiate with different axis names for exotic meshes (tests use the
+    default ``("data", "seq", "model")`` convention from parallel/mesh.py).
+    """
+
+    data_axis: str = "data"
+    seq_axis: str = "seq"
+    model_axis: str = "model"
+
+    # -- parameter roles --------------------------------------------------
+    def embedding(self) -> P:
+        """Vocab-parallel embedding / lm_head tables: [V, H], shard V."""
+        return P(self.model_axis, None)
+
+    def embedding_scale(self) -> P:
+        """Per-vocab-row int8 scales ride the sharded vocab axis."""
+        return P(self.model_axis)
+
+    def column_kernel(self) -> P:
+        """q/k/v/gate/up/lm_head [in, out]: shard out_features (heads /
+        MLP hidden) over ``model``."""
+        return P(None, self.model_axis)
+
+    def row_kernel(self) -> P:
+        """o/down [in, out]: shard in_features; XLA inserts the psum."""
+        return P(self.model_axis, None)
+
+    def column_bias(self) -> P:
+        """Biases and per-out-channel int8 scales of column-parallel
+        projections split with the out dim."""
+        return P(self.model_axis)
+
+    def expert_kernel(self) -> P:
+        """Stacked MoE kernels [E, in, out]: expert axis rides ``model``
+        (GSPMD inserts dispatch/combine all-to-alls)."""
+        return P(self.model_axis, None, None)
+
+    def expert_scale(self) -> P:
+        """MoE int8 scales [E, out] shard their expert axis the same."""
+        return P(self.model_axis, None)
+
+    def layer_norm(self) -> P:
+        """Norms (and the MoE router) are O(H): replicate."""
+        return P(None)
+
+    def replicated(self) -> P:
+        return P(None)
+
+    # -- serving-state roles ----------------------------------------------
+    def kv_pages(self, num_kv_heads: int, tp: int) -> P:
+        """[num_blocks, block_size, kv_heads*head_dim]: shard the fused
+        lane dim on kv-head boundaries when ``tp`` divides the head count
+        (the layout is kv-head-major, so a ``tp``-way lane split IS a head
+        split); otherwise replicate — a lane split that cuts a head
+        mid-``head_dim`` would psum every q·k dot."""
+        if tp > 1 and (tp > num_kv_heads or num_kv_heads % tp != 0):
+            return P(None, None, None)
+        if tp <= 1:
+            return P(None, None, None)
+        return P(None, None, self.model_axis)
+
+    def page_table(self) -> P:
+        """Block tables / context lengths: replicated.  Page ids are
+        GLOBAL — each chip reads the same table and its own head-slice of
+        every page, so the host allocator needs no mesh awareness."""
+        return P(None, None)
+
+    def prefill_tokens(self) -> P:
+        """Seq-parallel prefill: token batches [P, bucket] shard their
+        sequence axis when the mesh has a nontrivial ``seq`` degree."""
+        return P(None, self.seq_axis)
+
+    def batch(self) -> P:
+        """Activation batch sharding: batch over ``data``."""
+        return P(self.data_axis)
 
 
-_EXPERT = {"gate_e", "up_e", "down_e"}   # stacked [E, in, out] kernels
+#: The default layout every serving entry point derives its shardings from.
+DEFAULT_LAYOUT = SpecLayout()
 
 
-def _spec_for_path(path: tuple) -> P:
-    keys = [p.key for p in path if isinstance(p, jax.tree_util.DictKey)]
-    leaf = keys[-1]
-    parent = keys[-2] if len(keys) > 1 else ""
-    if parent == "embed" and leaf in ("weight", "weight_q"):
-        return P("model", None)                    # vocab-parallel
-    if parent == "embed" and leaf == "scale":
-        return P("model")                          # per-vocab-row scales
-    if parent in _EXPERT:
-        # Expert parallelism: the expert axis rides ``model`` — GSPMD
-        # inserts the dispatch/combine all-to-alls from this annotation
-        # (models/llama.py:_moe_mlp).  Kernels are [E, in, out]; int8
-        # scales are [E, out] and shard their expert axis the same way.
-        # The router stays replicated (O(H x E), every token needs it).
-        if leaf == "scale":
-            return P("model", None)
-        return P("model", None, None)
-    if leaf in ("kernel", "kernel_q"):
-        if parent in _COL:
-            return P(None, "model")
-        if parent in _ROW:
-            return P("model", None)
-    if leaf in ("bias", "scale"):
-        # int8 per-output-channel scales shard with the out dim, exactly
-        # like biases: split for column-parallel, replicated for row.
-        return P("model") if parent in _COL else P(None)
-    # norms and anything else: replicated
-    return P(None)
+def partition_rules(
+    layout: SpecLayout = DEFAULT_LAYOUT,
+) -> tuple[tuple[str, P], ...]:
+    """(path-regex, spec) pairs, first match wins; paths join the pytree's
+    dict keys with ``/`` (list indices dropped), e.g. ``layers/q/kernel``.
+    Expert rules precede column rules so ``up_e`` never matches ``up``."""
+    return (
+        (r"(^|/)embed/(weight|weight_q)$", layout.embedding()),
+        (r"(^|/)embed/scale$", layout.embedding_scale()),
+        (r"(^|/)(gate_e|up_e|down_e)/scale$", layout.expert_scale()),
+        (r"(^|/)(gate_e|up_e|down_e)/", layout.expert_kernel()),
+        (r"(^|/)(q|k|v|gate|up|lm_head)/(kernel|kernel_q)$",
+         layout.column_kernel()),
+        (r"(^|/)(o|down)/(kernel|kernel_q)$", layout.row_kernel()),
+        (r"(^|/)(q|k|v|gate|up|lm_head)/(bias|scale)$",
+         layout.column_bias()),
+        (r".*norm", layout.layer_norm()),
+    )
 
 
-def param_partition_specs(params: Any) -> Any:
+def _param_path_name(path: tuple) -> str:
+    return "/".join(
+        str(p.key) for p in path if isinstance(p, jax.tree_util.DictKey))
+
+
+def match_partition_rules(rules, params: Any) -> Any:
+    """Map a param pytree to PartitionSpecs by path regex (SNIPPETS.md
+    [2] idiom); unmatched leaves replicate."""
+    def spec_for(path, _leaf) -> P:
+        name = _param_path_name(path)
+        for pattern, spec in rules:
+            if re.search(pattern, name):
+                return spec
+        return P(None)
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
+
+
+def param_partition_specs(
+    params: Any, layout: SpecLayout = DEFAULT_LAYOUT,
+) -> Any:
     """PartitionSpec pytree matching a llama param pytree."""
-    return jax.tree_util.tree_map_with_path(lambda p, _: _spec_for_path(p), params)
+    return match_partition_rules(partition_rules(layout), params)
+
+
+def param_named_shardings(
+    params: Any, mesh: Mesh, layout: SpecLayout = DEFAULT_LAYOUT,
+) -> Any:
+    """The ``SpecLayout``-derived ``NamedSharding`` pytree the engine
+    device-puts weights with."""
+    return jax.tree.map(
+        lambda s: NamedSharding(mesh, s), param_partition_specs(params, layout),
+        is_leaf=lambda x: isinstance(x, P))
 
 
 def kv_pages_partition_specs(
     pages: KVPages, mesh: Mesh | None, num_kv_heads: int,
+    layout: SpecLayout = DEFAULT_LAYOUT,
 ) -> KVPages:
     """[num_blocks, block_size, kv_heads*head_dim] -> shard the fused lane
-    dim on kv-head boundaries.
-
-    The fused layout is kv-head-major, so splitting the lane dim ``tp`` ways
-    is exactly a kv-head split when ``tp`` divides ``num_kv_heads``.  When
-    TP exceeds the kv-head count (8-KV-head 70B on v5p-16) a lane split
-    would cut heads mid-``head_dim`` (every q·k dot would need a psum) —
-    replicate the pages instead, trading HBM for locality.
-    """
-    tp = mesh.shape["model"] if mesh is not None else 1
-    if mesh is not None and (tp > num_kv_heads or num_kv_heads % tp != 0):
-        spec = P(None, None, None)
-    else:
-        spec = P(None, None, "model")
+    dim on kv-head boundaries (see ``SpecLayout.kv_pages``)."""
+    tp = mesh.shape[layout.model_axis] if mesh is not None else 1
+    spec = layout.kv_pages(num_kv_heads, tp)
     return KVPages(
         k=[spec for _ in pages.k],
         v=[spec for _ in pages.v],
     )
 
 
-def shard_params(params: Any, mesh: Mesh) -> Any:
+def shard_params(
+    params: Any, mesh: Mesh, layout: SpecLayout = DEFAULT_LAYOUT,
+) -> Any:
     """Device-put params with TP sharding over ``mesh``."""
-    specs = param_partition_specs(params)
     return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
-    )
+        jax.device_put, params, param_named_shardings(params, mesh, layout))
 
 
 def batch_spec() -> P:
     """Activation batch sharding: batch over ``data``."""
-    return P("data")
+    return DEFAULT_LAYOUT.batch()

@@ -76,6 +76,7 @@ from k8s_llm_monitor_tpu.serving.kv_cache import (
     shareable_blocks,
 )
 from k8s_llm_monitor_tpu.serving.spec import (
+    AcceptanceEMA,
     accept_greedy,
     accept_sampled,
     propose_drafts,
@@ -531,11 +532,13 @@ class InferenceEngine:
         self.spec_tokens = 0         # tokens emitted by spec dispatches
         self.spec_verify_steps = 0   # verify forwards those tokens cost
         self.spec_lane_rounds = 0    # sum of active lanes over those forwards
-        # Adaptive speculation state: EMA of accepted tokens per lane-round
-        # (None = no measurement yet -> speculate optimistically) and the
-        # fused-dispatch count since the last probe.
-        self._spec_ema: Optional[float] = None
-        self._since_spec_probe = 0
+        # Adaptive speculation state: per-request-class EMA of accepted
+        # tokens per lane-round (serving/spec.py:AcceptanceEMA).  No
+        # measurement yet -> speculate optimistically; a class whose EMA
+        # stays under spec_min_accept has drafting auto-disabled (fused
+        # path) except for a probe every spec_probe_every dispatches.
+        self._spec_accept = AcceptanceEMA(floor=ec.spec_min_accept,
+                                          probe_every=ec.spec_probe_every)
 
         self._rng = jax.random.PRNGKey(seed)
         self._tok_state = jnp.zeros((ec.max_slots,), jnp.int32)
@@ -582,6 +585,10 @@ class InferenceEngine:
         self.decode_host_gap_ms = 0.0
         self.decode_attn_ms = 0.0
         self.decode_sample_ms = 0.0
+        # Per-step collective (ICI) share of the TP decode step, estimated
+        # by profile_decode_phases() from the measured step time and the
+        # ring-all-reduce byte model; 0.0 off-mesh or before profiling.
+        self.decode_collective_share = 0.0
 
     # ------------------------------------------------------------------
     # public API
@@ -1697,12 +1704,70 @@ class InferenceEngine:
         t_samp = run(sampled_prog, ctx_lo, sampled=True)
         self.decode_attn_ms = max(t_hi - t_lo, 0.0)
         self.decode_sample_ms = max(t_samp - t_lo, 0.0)
+        self.decode_collective_share = self._estimate_collective_share(t_lo)
         return {
             "decode_step_ms_short_ctx": t_lo,
             "decode_step_ms_long_ctx": t_hi,
             "decode_attn_ms": self.decode_attn_ms,
             "decode_sample_ms": self.decode_sample_ms,
+            "decode_collective_share": self.decode_collective_share,
         }
+
+    def mesh_axes(self) -> dict[str, int]:
+        """{axis: size} of the serving mesh ({} off-mesh) — the exporter's
+        ``mesh_axes`` topology gauge."""
+        return dict(self.mesh.shape) if self.mesh is not None else {}
+
+    def _estimate_collective_share(self, step_ms: float) -> float:
+        """Per-step ICI time share of the TP decode step (byte model).
+
+        Row-parallel o/down projections each psum a [B, hidden] activation
+        per layer; a ring all-reduce moves ``2*(tp-1)/tp`` of the payload
+        over each chip's links.  Dividing that wire time (at the chip's
+        aggregate ICI bandwidth) by the *measured* step time gives the
+        share the dashboard shows next to ``decode_attn_ms``.  It is an
+        estimate — collectives overlap compute on real meshes — and on the
+        forced-host CPU mesh the step time itself is a dryrun stand-in.
+        """
+        if self.mesh is None or step_ms <= 0.0:
+            return 0.0
+        tp = self.mesh.shape.get("model", 1)
+        if tp <= 1:
+            return 0.0
+        from k8s_llm_monitor_tpu.parallel.mesh import ici_bandwidth_gbs
+
+        cfg = self.cfg
+        act_bytes = 4 if cfg.dtype == "float32" else 2
+        payload = self.ecfg.max_slots * cfg.hidden_size * act_bytes
+        per_chip_bytes = (2 * cfg.num_layers          # o-proj + down-proj
+                          * 2.0 * (tp - 1) / tp * payload)
+        kind = self.mesh.devices.flat[0].device_kind
+        ici_ms = per_chip_bytes / (ici_bandwidth_gbs(kind) * 1e9) * 1e3
+        return min(1.0, ici_ms / step_ms)
+
+    @staticmethod
+    def _spec_class(lanes) -> str:
+        """Request class for adaptive speculation: greedy and sampled
+        traffic accept at very different rates (diagnosis queries quote
+        verbatim under greedy; sampled lanes diverge from the draft), so
+        their kill-switches are tracked separately.  A mixed batch is
+        scored as its most divergent member."""
+        return ("greedy"
+                if all(s.req.sampling.temperature <= 0.0 for _, s in lanes)
+                else "sampled")
+
+    @property
+    def _spec_ema(self) -> Optional[float]:
+        """Back-compat scalar view of the per-class acceptance EMAs: the
+        best class (a single healthy class keeps the scalar above the
+        floor, mirroring the pre-class behavior for one-class traffic)."""
+        snap = self._spec_accept.snapshot()
+        return max(snap.values()) if snap else None
+
+    def spec_accept_ema(self) -> dict:
+        """{request class: accepted-tokens-per-lane-round EMA} for the
+        exporter's ``spec_accept_ema`` gauge."""
+        return self._spec_accept.snapshot()
 
     def _spec_program(self, k: int, rounds: int, sampled: bool,
                       filtered: bool = False):
@@ -1847,13 +1912,8 @@ class InferenceEngine:
         # unmasked positions, so accepted drafts could violate the grammar.
         spec = ec.spec_k > 0 and not any(
             s.req.sampling.constrained for _, s in lanes)
-        if (spec and self._spec_ema is not None
-                and self._spec_ema < ec.spec_min_accept):
-            self._since_spec_probe += 1
-            if self._since_spec_probe < ec.spec_probe_every:
-                spec = False
-            else:
-                self._since_spec_probe = 0
+        if spec:
+            spec = self._spec_accept.should_draft(self._spec_class(lanes))
         if spec:
             # Emission per spec call is data-dependent (1..k+1 per round),
             # so a dispatch-ahead call would run with an overestimated ctx
@@ -2101,10 +2161,13 @@ class InferenceEngine:
             self.spec_lane_rounds += lane_rounds
             self.steps += ran
             if lane_rounds:
-                # Acceptance EMA drives the adaptive spec/fused choice.
-                rate = float(np.sum(arr >= 0)) / lane_rounds
-                self._spec_ema = (rate if self._spec_ema is None
-                                  else 0.8 * self._spec_ema + 0.2 * rate)
+                # Per-class acceptance EMA drives the adaptive spec/fused
+                # choice; the class is derived from the slots this call
+                # actually ran (meta holds the slot objects, so reuse of
+                # the lane index after dispatch cannot misattribute).
+                self._spec_accept.update(
+                    self._spec_class((i, s) for i, s, _ in call.lanes),
+                    int(np.sum(arr >= 0)), lane_rounds)
         else:
             arr = np.asarray(call.arr)
         if call.kind in ("decode", "spec"):

@@ -33,6 +33,20 @@ prefilled once instead of 100 times — the reference has no inference at all
 to cache (its LLM layer is config keys, reference
 internal/config/config.go:141-145); this is a north-star obligation
 (SURVEY.md §7 hard parts #1/#2).
+
+Mesh invariant — page ids are GLOBAL:
+
+  Under tensor parallelism the device-side page pool is sharded on the KV
+  *head* dimension (parallel/sharding.py ``SpecLayout.kv_pages``), never on
+  the page dimension.  Every chip therefore holds rows for *all*
+  ``num_blocks`` pages — each row just covers that chip's 1/tp slice of the
+  fused ``kv_heads * head_dim`` lane dim.  That is what lets everything in
+  THIS module stay mesh-agnostic: one BlockAllocator free list, one
+  PrefixCache, one page-table namespace serve every chip, block id ``b``
+  names the same logical page on chip 0 and chip 7, and prefix-cache hits
+  transfer across mesh shapes.  Nothing here may ever divide ``num_blocks``
+  by the mesh size; capacity planning divides *bytes per page* instead
+  (``page_slice_bytes``).
 """
 
 from __future__ import annotations
@@ -52,6 +66,22 @@ def shareable_blocks(n_tokens: int, block_size: int) -> int:
     truth for the shareable-span rule — PrefixCache.lookup/register and
     the engine's admission deferral gate must agree on it exactly."""
     return min(n_tokens // block_size, (n_tokens - 1) // block_size)
+
+
+def page_slice_bytes(num_kv_heads: int, head_dim: int, block_size: int,
+                     dtype_bytes: int, tp: int = 1) -> int:
+    """Bytes ONE chip holds for ONE logical KV page (K + V) under
+    head-dimension sharding.
+
+    With ``tp`` dividing ``num_kv_heads`` each chip stores a
+    ``kv_heads/tp`` slice of every page; otherwise the pool is replicated
+    (parallel/sharding.py ``SpecLayout.kv_pages``) and every chip pays the
+    full page.  Fit preflight multiplies this by ``num_blocks`` — the
+    page-id namespace itself never shrinks with the mesh (global-ids
+    invariant above)."""
+    sharded = 1 < tp <= num_kv_heads and num_kv_heads % tp == 0
+    heads = num_kv_heads // tp if sharded else num_kv_heads
+    return 2 * block_size * heads * head_dim * dtype_bytes
 
 
 class OutOfBlocks(Exception):

@@ -45,8 +45,72 @@ free under the decode weight-bandwidth ceiling.)
 
 from __future__ import annotations
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class AcceptanceEMA:
+    """Per-request-class accepted-length EMA with an auto-disable floor.
+
+    Drafting only pays when verify forwards emit enough tokens to beat the
+    fused pipelined decode path; below ``floor`` accepted tokens per
+    lane-round, the draft overhead is pure loss (BENCH_r04/r05 measured a
+    flat 1.00 on random-init weights).  The engine feeds every reconciled
+    spec call's measured acceptance in here, keyed by request *class*
+    (greedy vs sampled traffic accept at very different rates — a sampled
+    class collapsing must not disable drafting for greedy quoting traffic),
+    and asks ``should_draft`` before each dispatch.  A killed class still
+    re-probes every ``probe_every`` fused dispatches so recovery (e.g. the
+    workload starts quoting its context) is observed, not assumed.
+
+    Host-side bookkeeping only — nothing here is traced.
+    """
+
+    floor: float = 1.2
+    probe_every: int = 32
+    alpha: float = 0.2  # EMA weight of the newest measurement
+
+    _ema: dict = dataclasses.field(default_factory=dict)
+    _since_probe: dict = dataclasses.field(default_factory=dict)
+
+    def update(self, klass: str, accepted: int, lane_rounds: int) -> None:
+        """Fold one reconciled spec call's acceptance into the class EMA."""
+        if lane_rounds <= 0:
+            return
+        rate = float(accepted) / float(lane_rounds)
+        prev = self._ema.get(klass)
+        self._ema[klass] = (rate if prev is None
+                            else (1.0 - self.alpha) * prev + self.alpha * rate)
+
+    def ema(self, klass: str):
+        """The class EMA, or None before any measurement."""
+        return self._ema.get(klass)
+
+    def drafting_disabled(self, klass: str) -> bool:
+        """True when the kill-switch is engaged for this class (EMA
+        measured and below the floor)."""
+        ema = self._ema.get(klass)
+        return ema is not None and ema < self.floor
+
+    def should_draft(self, klass: str) -> bool:
+        """Gate one dispatch: True while the class EMA is unmeasured or at/
+        above the floor; once killed, True only for the periodic probe."""
+        if not self.drafting_disabled(klass):
+            self._since_probe[klass] = 0
+            return True
+        count = self._since_probe.get(klass, 0) + 1
+        if count >= self.probe_every:
+            self._since_probe[klass] = 0
+            return True
+        self._since_probe[klass] = count
+        return False
+
+    def snapshot(self) -> dict:
+        """{class: ema} for the exporter's ``spec_accept_ema`` gauge."""
+        return dict(self._ema)
 
 
 def propose_drafts(

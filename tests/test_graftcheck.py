@@ -414,11 +414,14 @@ def test_cli_exit_codes(tmp_path, capsys):
 
 @pytest.mark.slow  # builds a real engine (~15s); tier-1 is within ~40s of
 # its timeout budget, so the trace gates run via `make lint-trace` + `make test`
-@pytest.mark.parametrize("decode_path", ["gather", "fused"])
+@pytest.mark.parametrize("decode_path", ["gather", "fused", "mesh"])
 def test_same_bucket_reinvocation_compiles_nothing(decode_path):
     """The acceptance gate: warm both prefill programs + the decode ladder,
     then rerun same-shaped requests with different content — the program
-    caches must not grow and no backend compile may fire."""
+    caches must not grow and no backend compile may fire.  The "mesh" path
+    runs the same gate on a GSPMD TP-8 engine over the forced 8-host-device
+    mesh (sharded weights + head-sharded KV pages), proving zero recompiles
+    and donated page-pool/token-state rebinding survive sharding."""
     from k8s_llm_monitor_tpu.devtools import traceguard
 
     report = traceguard.check_path(decode_path)

@@ -82,6 +82,65 @@ def test_prometheus_exporter_gauges(engine_server):
             <= vals["k8s_llm_monitor_engine_kv_blocks_total"])
 
 
+def test_spec_accept_and_overhead_gauges(engine_server):
+    """Per-class spec-acceptance gauge appears once a class has a
+    measurement; the constrained-decode overhead gauge is ALWAYS present
+    on a local-engine backend (0.0 until both decode classes observed);
+    an off-mesh engine emits no mesh topology gauges."""
+    srv, engine = engine_server
+    text = _metrics_text(srv.port)
+    assert "k8s_llm_monitor_constrained_decode_overhead_ms" in text
+    assert "mesh_axes" not in text                 # single-device engine
+    assert "spec_accept_ema" not in text           # no measurement yet
+    engine._spec_accept.update("greedy", accepted=4, lane_rounds=4)
+    text = _metrics_text(srv.port)
+    assert 'k8s_llm_monitor_spec_accept_ema{class="greedy"} 1.0' in text
+
+
+def test_overhead_gauge_emits_nan_marker_for_nonlocal_backend():
+    """Satellite 6: backends that don't measure the constrained-decode tax
+    (remote/openai/template) must still emit the gauge — as an explicit
+    NaN — so the router's proxied /metrics never silently mixes a
+    population that has the series with one that lacks it."""
+    from k8s_llm_monitor_tpu.monitor.exporter import (
+        _diagnosis_metrics,
+        _Writer,
+    )
+
+    w = _Writer()
+    _diagnosis_metrics(w, None, object())   # backend without the EMA attr
+    assert ("k8s_llm_monitor_constrained_decode_overhead_ms NaN"
+            in w.render())
+
+
+def test_mesh_topology_gauges_on_tp_engine():
+    """A mesh-native engine exports its axis sizes and the collective-share
+    estimate, so dashboards can tell a TP-8 slice from a single chip."""
+    from k8s_llm_monitor_tpu.monitor.exporter import _engine_metrics, _Writer
+    from k8s_llm_monitor_tpu.parallel.mesh import MeshConfig, create_mesh
+
+    n_dev = len(jax.devices())
+    mesh = create_mesh(MeshConfig(model=n_dev))
+    # TP-shardable geometry (the module CFG's 300-row vocab doesn't divide
+    # the vocab-parallel embedding 8 ways).
+    tp_cfg = ModelConfig(name="tp-t", vocab_size=512, hidden_size=64,
+                         intermediate_size=128, num_layers=2, num_heads=8,
+                         num_kv_heads=8, dtype="float32", rope_theta=1e4)
+    params = llama.init_params(jax.random.PRNGKey(1), tp_cfg)
+    eng = InferenceEngine(
+        tp_cfg, params,
+        EngineConfig(max_slots=2, num_blocks=32, block_size=16,
+                     max_blocks_per_seq=8, prefill_buckets=(64,)),
+        mesh=mesh)
+    w = _Writer()
+    _engine_metrics(w, eng)
+    text = w.render()
+    assert f'k8s_llm_monitor_mesh_axes{{axis="model"}} {n_dev}' in text
+    assert 'k8s_llm_monitor_mesh_axes{axis="data"} 1' in text
+    assert "k8s_llm_monitor_engine_decode_collective_share 0.0" in text
+    assert eng.mesh_axes()["model"] == n_dev
+
+
 def test_ttft_histogram_counts_queries(engine_server):
     srv, engine = engine_server
     before = engine.ttft_count

@@ -36,6 +36,23 @@ def test_70b_int8_tp16_fits_v5p(capsys):
     assert "GiB/chip at TP-16" in out
 
 
+def test_qwen2_72b_int8_tp8_fits_v5e(capsys):
+    """The ISSUE's unlock gate: Qwen2-72B (80L, 64q/8kv heads, 152k vocab)
+    must pass the fit preflight on an 8-chip v5e mesh spec with int8
+    weights — 8.47 GiB/chip weights + head-sharded KV under the 16 GiB
+    budget, every sharded axis dividing TP-8."""
+    rc = main(["--model", "qwen2-72b", "--quantize", "int8",
+               "--mesh", "1,1,8", "--per-chip-hbm-gib", "16",
+               "--kv-blocks", "1024"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "heads=64/8kv" in out
+    assert "q-heads/FFN/vocab all divide model=8" in out
+    assert "kv_heads=8 shard 8-way" in out
+    assert "8.47 GiB/chip at TP-8" in out
+    assert "preflight: PASS" in out
+
+
 def test_indivisible_tp_fails(capsys):
     rc = main(["--model", "llama3-8b", "--mesh", "1,1,3"])
     out = capsys.readouterr().out

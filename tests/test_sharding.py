@@ -5,6 +5,7 @@ nearly-bit (same program, XLA inserts collectives from the annotations).
 """
 
 import numpy as np
+import pytest
 
 import jax
 import jax.numpy as jnp
@@ -94,8 +95,6 @@ def test_seq_sharded_prefill_engine_matches_unsharded(cpu_mesh_devices):
 
 
 def test_seq_mesh_rejects_indivisible_buckets(cpu_mesh_devices):
-    import pytest
-
     mesh = create_mesh(MeshConfig(data=1, seq=2, model=4))
     params = llama.init_params(jax.random.PRNGKey(2), CFG)
     ecfg = EngineConfig(max_slots=2, num_blocks=32, block_size=8,
@@ -213,6 +212,110 @@ def test_tp_engine_selects_pallas_kernel_path(cpu_mesh_devices):
         cfg3, llama.init_params(jax.random.PRNGKey(1), cfg3),
         ecfg, eos_id=-1, mesh=mesh)
     assert eng2._attn_impl is paged_decode_attention
+
+
+def test_spec_layout_roles_and_rules():
+    """SpecLayout is the single source of the axis layout; the regex rules
+    bind its role methods to param paths (first match wins, unmatched
+    leaves replicate, list indices drop out of paths)."""
+    from k8s_llm_monitor_tpu.parallel.sharding import (
+        DEFAULT_LAYOUT,
+        SpecLayout,
+        match_partition_rules,
+        partition_rules,
+    )
+
+    lay = DEFAULT_LAYOUT
+    assert lay.column_kernel() == P(None, "model")
+    assert lay.row_kernel() == P("model", None)
+    assert lay.embedding() == P("model", None)
+    assert lay.layer_norm() == P(None)
+    # KV pages: head-slice only when tp divides the kv-head count; any
+    # other degree must replicate (a mid-head lane split is wrong, not
+    # just slow).
+    assert lay.kv_pages(8, 8) == P(None, None, "model")
+    assert lay.kv_pages(8, 16) == P(None, None, None)
+    assert lay.kv_pages(8, 3) == P(None, None, None)
+    assert lay.kv_pages(8, 1) == P(None, None, None)
+    # Page tables never shard: block ids are global (kv_cache.py).
+    assert lay.page_table() == P(None, None)
+
+    params = {"layers": [{"q": {"kernel": 0}, "o": {"kernel": 0},
+                          "up_e": {"kernel": 0}, "input_norm": 0}],
+              "embed": {"weight": 0}, "final_norm": 0, "odd_leaf": 0}
+    specs = match_partition_rules(partition_rules(lay), params)
+    assert specs["layers"][0]["q"]["kernel"] == P(None, "model")
+    assert specs["layers"][0]["o"]["kernel"] == P("model", None)
+    assert specs["layers"][0]["up_e"]["kernel"] == P("model", None, None)
+    assert specs["layers"][0]["input_norm"] == P(None)
+    assert specs["embed"]["weight"] == P("model", None)
+    assert specs["odd_leaf"] == P(None)          # unmatched -> replicate
+
+    # Axis names flow from the layout, not from hardcoded strings.
+    alt = SpecLayout(model_axis="tp")
+    assert alt.column_kernel() == P(None, "tp")
+    assert alt.kv_pages(8, 2) == P(None, None, "tp")
+
+
+def test_page_slice_bytes_divides_heads_not_pages():
+    from k8s_llm_monitor_tpu.serving.kv_cache import page_slice_bytes
+
+    full = page_slice_bytes(8, 64, 16, 2, tp=1)
+    assert full == 2 * 16 * 8 * 64 * 2
+    assert page_slice_bytes(8, 64, 16, 2, tp=8) == full // 8
+    # Indivisible/oversubscribed TP replicates: the full page per chip.
+    assert page_slice_bytes(8, 64, 16, 2, tp=16) == full
+    assert page_slice_bytes(8, 64, 16, 2, tp=3) == full
+
+
+@pytest.mark.slow  # builds two full engines (~30s on one core); the gate
+# still runs in CI via `make tier1-mesh`, which applies no marker filter
+def test_tp_mixed_traffic_parity_incl_constrained(cpu_mesh_devices):
+    """The ISSUE's parity gate: TP-8 and 1-device engines must produce
+    byte-identical greedy token streams over one mixed submission wave —
+    a chunked long-prompt admission (> top bucket), dense short prefills,
+    multi-round decode, and a grammar-constrained verdict lane sharing
+    the batch."""
+    from k8s_llm_monitor_tpu.diagnosis.grammar import verdict_fsm
+    from k8s_llm_monitor_tpu.serving.engine import GenerationRequest
+    from k8s_llm_monitor_tpu.utils.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    params = llama.init_params(jax.random.PRNGKey(4), CFG)
+    ecfg = EngineConfig(max_slots=4, num_blocks=128, block_size=8,
+                        max_blocks_per_seq=32, prefill_buckets=(16,),
+                        decode_steps_per_iter=4)
+    rng = np.random.default_rng(5)
+    reqs = [
+        ("long", [int(t) for t in rng.integers(2, 250, size=40)],
+         SamplingParams(max_tokens=8)),                  # 40 > 16: chunked
+        ("short-a", [int(t) for t in rng.integers(2, 250, size=7)],
+         SamplingParams(max_tokens=8)),                  # dense admission
+        ("short-b", [int(t) for t in rng.integers(2, 250, size=5)],
+         SamplingParams(max_tokens=12)),                 # uneven drain
+        ("verdict", tok.encode("why is default/web crashlooping?"),
+         SamplingParams(max_tokens=1, constrained=True)),  # grammar lane
+    ]
+
+    def run(mesh):
+        eng = InferenceEngine(CFG, params, ecfg, tokenizer=tok, mesh=mesh)
+        eng.set_grammar(verdict_fsm(eos_id=tok.eos_id))
+        for rid, prompt, sp in reqs:
+            eng.submit(GenerationRequest(
+                request_id=rid, prompt_ids=list(prompt), sampling=sp))
+        while eng.has_work:
+            eng.step()
+        out = {}
+        for rid, _, _ in reqs:
+            res = eng.poll(rid)
+            assert res is not None and res.finish_reason != "error", res
+            out[rid] = res.token_ids
+        return out
+
+    plain = run(None)
+    tp = run(create_mesh(MeshConfig(model=8)))
+    assert plain == tp
+    assert len(tp["verdict"]) > 0
 
 
 def test_init_multihost_single_host_noop(cpu_mesh_devices):

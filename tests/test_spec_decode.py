@@ -513,3 +513,49 @@ def test_spec_adapts_off_at_low_acceptance(params):
     assert eng.spec_verify_steps < eng.steps / 2, (
         eng.spec_verify_steps, eng.steps)
     assert eng._spec_ema is not None and eng._spec_ema < 1.2
+    # Per-request-class bookkeeping: greedy-only traffic populates only the
+    # "greedy" class, and the exporter snapshot mirrors it.
+    snap = eng.spec_accept_ema()
+    assert set(snap) == {"greedy"}
+    assert snap["greedy"] < 1.2
+
+
+def test_acceptance_ema_flat_acceptance_flips_kill_switch():
+    """Satellite gate: a class whose accepted-length EMA sits flat under
+    the floor must have drafting auto-disabled, re-enabled only as a
+    periodic probe; a healthy class on the same tracker stays drafting."""
+    from k8s_llm_monitor_tpu.serving.spec import AcceptanceEMA
+
+    ema = AcceptanceEMA(floor=1.2, probe_every=4)
+    assert ema.should_draft("greedy")          # no measurement yet: draft
+    assert ema.ema("greedy") is None
+
+    # Flat 1.0 acceptance (1 accepted token per lane-round): EMA converges
+    # below the 1.2 floor and the kill-switch flips.
+    for _ in range(20):
+        ema.update("greedy", accepted=4, lane_rounds=4)
+    assert ema.drafting_disabled("greedy")
+    assert ema.ema("greedy") < 1.2
+
+    # Disabled class: exactly one probe per probe_every dispatches.
+    draws = [ema.should_draft("greedy") for _ in range(8)]
+    assert draws.count(True) == 2 and draws[3] and draws[7]
+
+    # An independent healthy class is untouched by greedy's kill-switch.
+    for _ in range(20):
+        ema.update("sampled", accepted=12, lane_rounds=4)
+    assert not ema.drafting_disabled("sampled")
+    assert all(ema.should_draft("sampled") for _ in range(8))
+    assert ema.drafting_disabled("greedy")
+
+    snap = ema.snapshot()
+    assert snap["greedy"] < 1.2 < snap["sampled"]
+
+
+def test_spec_min_accept_config_plumbs_to_engine():
+    """monitor config -> EngineConfig -> AcceptanceEMA floor."""
+    from k8s_llm_monitor_tpu.monitor.config import TPULLMConfig
+    from k8s_llm_monitor_tpu.serving.engine import EngineConfig
+
+    tpu_cfg = TPULLMConfig()
+    assert tpu_cfg.spec_min_accept == EngineConfig().spec_min_accept == 1.2
