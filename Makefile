@@ -6,7 +6,8 @@ TEST_ENV = env PYTHONPATH= JAX_PLATFORMS=cpu
 SHELL := /bin/bash    # tier1 uses pipefail/PIPESTATUS
 
 .PHONY: run run-agent run-scheduler demo test test-fast tier1 tier1-mesh \
-        chaos chaos-lifecycle chaos-fleet diagnose-e2e bench bench-decode \
+        chaos chaos-lifecycle chaos-fleet chaos-overload diagnose-e2e \
+        bench bench-decode \
         bench-fleet bench-mesh dryrun smoke preflight deploy-agent docker \
         docker-agent docker-scheduler lint lint-trace clean
 
@@ -65,6 +66,14 @@ chaos-lifecycle:
 chaos-fleet:
 	$(TEST_ENV) K8SLLM_LOCKCHECK=1 \
 	  $(PY) -m pytest tests/test_fleet.py -q -p no:cacheprovider
+
+# SLO-class overload acceptance: class-ordered shedding, preemptive lane
+# eviction (byte-exact, with seeded eviction faults), the brownout ladder,
+# and the 3x-capacity mixed-class burst (docs/resilience.md) — with lock
+# discipline checked.
+chaos-overload:
+	$(TEST_ENV) K8SLLM_LOCKCHECK=1 \
+	  $(PY) -m pytest tests/test_overload.py -q -p no:cacheprovider
 
 # Diagnosis acceptance (docs/diagnosis.md): grammar compiler units, the
 # constrained-sampling fuzz (every sample parses), and the synthetic

@@ -507,6 +507,49 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001 — extras never fail the bench
         log(f"shared-prefix leg skipped: {exc}")
 
+    # --- SLO-class leg: the same burst with classes attached (round-robin
+    # interactive/standard/batch).  Class scheduling sorts admission and
+    # evicts batch lanes for interactive arrivals, so interactive must hold
+    # a tight tail (p99 <= 2x p50) while batch absorbs the queueing. ------
+    slo_class_stats = None
+    try:
+        slo_classes = ("interactive", "standard", "batch")
+        slo_rids = []
+        for i in range(n_requests):
+            c = slo_classes[i % len(slo_classes)]
+            rid = f"slo-{i}"
+            slo_rids.append((rid, c))
+            eng.submit(GenerationRequest(
+                request_id=rid, prompt_ids=prompt(),
+                sampling=SamplingParams(max_tokens=max_tokens),
+                slo_class=c))
+        while eng.has_work:
+            eng.step()
+        by_class: dict[str, list] = {c: [] for c in slo_classes}
+        for rid, c in slo_rids:
+            r = eng.poll(rid)
+            assert r is not None and r.finish_reason != "error"
+            by_class[c].append(r)
+        slo_class_stats = {}
+        for c in slo_classes:
+            c_p50, c_p99 = ttft_pcts(by_class[c])
+            slo_class_stats[c] = {"p50_ttft_ms": round(c_p50, 2),
+                                  "p99_ttft_ms": round(c_p99, 2),
+                                  "n": len(by_class[c])}
+        ia = slo_class_stats["interactive"]
+        ia["p99_over_p50"] = round(
+            ia["p99_ttft_ms"] / max(ia["p50_ttft_ms"], 1e-9), 2)
+        ia["tail_ok"] = ia["p99_ttft_ms"] <= 2.0 * ia["p50_ttft_ms"]
+        for c in slo_classes:
+            s = slo_class_stats[c]
+            log(f"slo-class {c}: p50 TTFT {s['p50_ttft_ms']:.1f} ms, "
+                f"p99 {s['p99_ttft_ms']:.1f} ms ({s['n']} reqs)")
+        log(f"interactive tail under mixed-class burst: "
+            f"p99/p50 = {ia['p99_over_p50']:.2f}x "
+            f"({'OK' if ia['tail_ok'] else 'OVER'} budget 2.00x)")
+    except Exception as exc:  # noqa: BLE001 — extras never fail the bench
+        log(f"slo-class leg skipped: {exc}")
+
     # --- utilization micro-legs on the warm compiled programs -----------
     prefill_tflops = prefill_mfu = 0.0
     decode_gbs = decode_bw_util = 0.0
@@ -1455,6 +1498,10 @@ def main() -> None:
         extras["shared_prefix_p50_ttft_ms"] = round(shared_p50_ms, 2)
         extras["shared_prefix_p99_ttft_ms"] = round(shared_p99_ms, 2)
         extras["shared_prefix_len"] = shared_len
+    if slo_class_stats is not None:
+        # Per-class TTFT under the mixed-class burst; the interactive
+        # entry carries the p99 <= 2x p50 tail verdict (tail_ok).
+        extras["slo_class_burst"] = slo_class_stats
     if prefill_tflops:
         extras["prefill_tflops"] = round(prefill_tflops, 1)
         extras["prefill_mfu"] = round(prefill_mfu, 3)

@@ -233,12 +233,18 @@ class DiagnosisPipeline:
     """
 
     def __init__(self, analysis, cfg=None, *, embedder: Any = None,
+                 brownout: Callable[[], int] | None = None,
                  clock: Callable[[], float] = time.monotonic) -> None:
         from k8s_llm_monitor_tpu.monitor.config import DiagnosisConfig
 
         self.analysis = analysis
         self.cfg = cfg or DiagnosisConfig()
         self._clock = clock
+        # Brownout rung supplier (resilience/slo.py): at draining (>= 2)
+        # trigger enqueue pauses — the engine is shedding real traffic, so
+        # background diagnosis must not compete for its slots.
+        self._brownout = brownout
+        self.paused_total = 0
         self.detector = BurstDetector(
             threshold=self.cfg.burst_threshold,
             window_s=self.cfg.window_s,
@@ -268,6 +274,14 @@ class DiagnosisPipeline:
         if event.type != "Warning":
             return
         if self.detector.observe():
+            if self._brownout is not None:
+                try:
+                    paused = int(self._brownout()) >= 2
+                except Exception:  # noqa: BLE001 — never drop the watcher
+                    paused = False
+                if paused:
+                    self.paused_total += 1
+                    return
             self.triggers_total += 1
             self._queue.put({
                 "reason": event.reason or "warning burst",
@@ -321,7 +335,10 @@ class DiagnosisPipeline:
             "root cause and the first remediation step."
         )
         context = self.context.assemble(question)
-        verdict = self.analysis.diagnose(question, context=context)
+        # Background root-cause work rides the lowest lane: interactive
+        # operators must never queue behind an automatic trigger.
+        verdict = self.analysis.diagnose(question, context=context,
+                                         slo_class="batch")
         self.queries_total += 1
         lag_ms = max(0.0, (self._clock() - t_trigger) * 1000.0)
         self.store.publish(

@@ -45,11 +45,13 @@ class FleetAnalysis:
             error_kind=str(payload.get("error_kind", "")),
         )
 
-    def query(self, question: str) -> AnalysisResponse:
-        return self._to_response(self.router.query(question))
+    def query(self, question: str,
+              slo_class: str = "interactive") -> AnalysisResponse:
+        return self._to_response(
+            self.router.query(question, slo_class=slo_class))
 
-    def query_stream(self, question: str):
-        return self.router.query_stream(question)
+    def query_stream(self, question: str, slo_class: str = "interactive"):
+        return self.router.query_stream(question, slo_class=slo_class)
 
     def analyze(self, request: AnalysisRequest) -> AnalysisResponse:
         return self._to_response(self.router.analyze({
@@ -98,7 +100,8 @@ def build_router_server(config, web_dir=None):
                           min_delay_s=fcfg.hedge_min_delay_s,
                           fixed_delay_s=fcfg.hedge_fixed_delay_s),
         max_failovers=fcfg.max_failovers,
-        affinity_prefix_tokens=fcfg.affinity_prefix_tokens)
+        affinity_prefix_tokens=fcfg.affinity_prefix_tokens,
+        batch_spill_threshold=fcfg.batch_spill_threshold)
     registry.refresh()
     registry.start_probes(interval_s=fcfg.probe_interval_s)
     logger.info("router fronting %d replica(s), policy=%s, hedging=%s",

@@ -40,6 +40,11 @@ class ReplicaStats:
     total_slots: int = 0
     prefix_hits: int = 0
     prefix_misses: int = 0
+    # Per-SLO-class queued tokens and the replica's brownout rung
+    # (resilience/slo.py) — class-aware routing signals; absent keys mean
+    # a pre-class replica (treated as all-standard, normal).
+    queue_by_class: dict = dataclasses.field(default_factory=dict)
+    brownout: int = 0
 
     @property
     def prefix_hit_rate(self) -> float:
@@ -51,6 +56,7 @@ class ReplicaStats:
         """Parse the ``/api/v1/stats`` response body (``engine`` block)."""
         eng = (payload or {}).get("engine") or {}
         pc = eng.get("prefix_cache") or {}
+        by_class = eng.get("queue_tokens_by_class") or {}
         return cls(
             queue_depth=int(eng.get("queue_depth", 0)),
             queue_tokens=int(eng.get("queue_tokens", 0)),
@@ -58,6 +64,8 @@ class ReplicaStats:
             total_slots=int(eng.get("total_slots", 0)),
             prefix_hits=int(pc.get("hits", 0)),
             prefix_misses=int(pc.get("misses", 0)),
+            queue_by_class={str(k): int(v) for k, v in by_class.items()},
+            brownout=int(eng.get("brownout", 0)),
         )
 
 
@@ -243,6 +251,8 @@ class ReplicaRegistry:
                     "breaker_state": e.breaker.state,
                     "queue_depth": e.stats.queue_depth,
                     "queue_tokens": e.stats.queue_tokens,
+                    "queue_by_class": dict(e.stats.queue_by_class),
+                    "brownout": e.stats.brownout,
                     "busy_slots": e.stats.busy_slots,
                     "total_slots": e.stats.total_slots,
                     "prefix_hit_rate": round(e.stats.prefix_hit_rate, 4),

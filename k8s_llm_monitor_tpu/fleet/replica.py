@@ -50,16 +50,17 @@ class Replica:
     # -- token-level generation (in-process replicas) -------------------
 
     def generate(self, prompt_ids: list[int], sampling=None,
-                 request_id: str | None = None, deadline_s: float = 0.0):
+                 request_id: str | None = None, deadline_s: float = 0.0,
+                 slo_class: str = "standard"):
         """Submit one generation; returns a ``RequestHandle``."""
         raise NotImplementedError(f"{self.replica_id}: token interface")
 
     # -- text-level query API (HTTP replicas) ---------------------------
 
-    def query(self, question: str) -> dict:
+    def query(self, question: str, slo_class: str = "interactive") -> dict:
         raise NotImplementedError(f"{self.replica_id}: query interface")
 
-    def query_stream(self, question: str):
+    def query_stream(self, question: str, slo_class: str = "interactive"):
         """Returns (request_id, model, iterator of text deltas)."""
         raise NotImplementedError(f"{self.replica_id}: query interface")
 
@@ -124,20 +125,23 @@ class LocalReplica(Replica):
             total_slots=engine.ecfg.max_slots,
             prefix_hits=pc.hits if pc is not None else 0,
             prefix_misses=pc.misses if pc is not None else 0,
+            queue_by_class=engine.queue_tokens_by_class(),
+            brownout=engine.brownout() if engine.brownout is not None else 0,
         )
 
     def generate(self, prompt_ids: list[int], sampling=None,
-                 request_id: str | None = None, deadline_s: float = 0.0):
+                 request_id: str | None = None, deadline_s: float = 0.0,
+                 slo_class: str = "standard"):
         if self._killed:
             raise ReplicaUnavailable(f"{self.replica_id}: killed")
         try:
             if self.supervisor is not None:
                 return self.supervisor.submit(
                     prompt_ids, sampling, request_id=request_id,
-                    deadline_s=deadline_s)
+                    deadline_s=deadline_s, slo_class=slo_class)
             return self.service.submit(
                 prompt_ids, sampling, request_id=request_id,
-                deadline_s=deadline_s)
+                deadline_s=deadline_s, slo_class=slo_class)
         except RuntimeError as exc:
             # Dead service: a routing fact, not a caller error.
             raise ReplicaUnavailable(str(exc)) from exc
@@ -183,19 +187,19 @@ class HTTPReplica(Replica):
     def stats(self) -> ReplicaStats:
         return ReplicaStats.from_payload(self.client.stats())
 
-    def query(self, question: str) -> dict:
+    def query(self, question: str, slo_class: str = "interactive") -> dict:
         from k8s_llm_monitor_tpu.monitor.client import ApiConnectionError
 
         try:
-            return self.client.query(question)
+            return self.client.query(question, slo_class=slo_class)
         except ApiConnectionError as exc:
             raise ReplicaUnavailable(str(exc)) from exc
 
-    def query_stream(self, question: str):
+    def query_stream(self, question: str, slo_class: str = "interactive"):
         from k8s_llm_monitor_tpu.monitor.client import ApiConnectionError
 
         try:
-            return self.client.query_stream(question)
+            return self.client.query_stream(question, slo_class=slo_class)
         except ApiConnectionError as exc:
             raise ReplicaUnavailable(str(exc)) from exc
 

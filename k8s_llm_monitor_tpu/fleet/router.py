@@ -67,6 +67,11 @@ def _load_score(c: Candidate) -> float:
     return c.stats.queue_tokens + 64.0 * slot_pressure + 16.0 * c.inflight
 
 
+def _slot_utilization(c: Candidate) -> float:
+    return (c.stats.busy_slots / c.stats.total_slots
+            if c.stats.total_slots else 0.0)
+
+
 class RoutingPolicy:
     name = "base"
 
@@ -181,6 +186,7 @@ class _Flight:
     sampling: SamplingParams
     deadline_s: float
     digest: bytes
+    slo_class: str
     handle: RequestHandle               # fleet-level, what the caller holds
     inner: Optional[RequestHandle]      # current replica-level handle
     replica_id: str
@@ -207,7 +213,8 @@ class FleetRouter:
     def __init__(self, registry: ReplicaRegistry, policy: str = "affinity",
                  hedge: HedgeConfig | None = None, max_failovers: int = 2,
                  affinity_prefix_tokens: int = 64,
-                 stall_timeout_s: float = 120.0):
+                 stall_timeout_s: float = 120.0,
+                 batch_spill_threshold: float = 0.75):
         if policy not in POLICIES:
             raise ValueError(
                 f"unknown policy {policy!r} (have {sorted(POLICIES)})")
@@ -217,6 +224,9 @@ class FleetRouter:
         self.max_failovers = max_failovers
         self.affinity_prefix_tokens = affinity_prefix_tokens
         self.stall_timeout_s = stall_timeout_s
+        # SLO-class routing (resilience/slo.py): batch only spills off its
+        # affinity target onto replicas below this slot utilization.
+        self.batch_spill_threshold = batch_spill_threshold
         self._ids = itertools.count()
         # counters (exporter gauges)
         self.dispatches = 0
@@ -286,12 +296,27 @@ class FleetRouter:
             return max(self.hedge.min_delay_s, self.hedge.cold_delay_s)
         return max(self.hedge.min_delay_s, m + self.hedge.p95_mult * dev)
 
-    def _ranked(self, digest: bytes,
-                need_tokens: bool) -> list[Candidate]:
+    def _ranked(self, digest: bytes, need_tokens: bool,
+                slo_class: str = "standard") -> list[Candidate]:
         cands = [c for c in self.registry.candidates()
                  if (c.replica.supports_tokens if need_tokens
                      else c.replica.supports_query)]
-        return self.policy.rank(cands, digest)
+        # Interactive traffic beats cache locality: always least-loaded,
+        # whatever the configured policy, so an operator query never queues
+        # behind the affinity target's backlog.
+        if slo_class == "interactive":
+            return sorted(cands,
+                          key=lambda c: (_load_score(c), c.replica_id))
+        ranked = self.policy.rank(cands, digest)
+        # Batch keeps its affinity head (prefix pages are most valuable for
+        # the long contexts batch carries) but only spills onto replicas
+        # with headroom — saturating a second replica with background work
+        # would steal slots from the classes above it.
+        if slo_class == "batch" and len(ranked) > 1:
+            ranked = [ranked[0]] + [
+                c for c in ranked[1:]
+                if _slot_utilization(c) < self.batch_spill_threshold]
+        return ranked
 
     def _account_affinity(self, digest: bytes, chosen: str,
                           candidates: list[Candidate]) -> None:
@@ -305,7 +330,8 @@ class FleetRouter:
     def _dispatch_tokens(self, ranked: list[Candidate],
                          prompt_ids: list[int], sampling: SamplingParams,
                          request_id: str, deadline_s: float,
-                         exclude: frozenset[str] | set[str] = frozenset()):
+                         exclude: frozenset[str] | set[str] = frozenset(),
+                         slo_class: str = "standard"):
         """Try candidates in rank order; returns (replica_id, handle) or
         (None, last_error).  Breaker gates each attempt."""
         last_exc: Exception | None = None
@@ -323,7 +349,7 @@ class FleetRouter:
             try:
                 handle = cand.replica.generate(
                     prompt_ids, sampling, request_id=request_id,
-                    deadline_s=deadline_s)
+                    deadline_s=deadline_s, slo_class=slo_class)
             except OverloadedError as exc:
                 entry.breaker.record_success()  # alive, just shedding
                 last_exc = exc
@@ -341,18 +367,20 @@ class FleetRouter:
     def submit(self, prompt_ids: list[int],
                sampling: SamplingParams | None = None,
                request_id: str | None = None,
-               deadline_s: float = 0.0) -> RequestHandle:
+               deadline_s: float = 0.0,
+               slo_class: str = "standard") -> RequestHandle:
         """Admit one generation into the fleet.  Raises ``OverloadedError``
         when no replica will take it (counted as a shed); otherwise returns
         a handle whose stream survives replica death transparently."""
         sampling = sampling or SamplingParams()
         rid = request_id or f"fleet-{next(self._ids)}"
         digest = self._token_digest(prompt_ids)
-        ranked = self._ranked(digest, need_tokens=True)
+        ranked = self._ranked(digest, need_tokens=True, slo_class=slo_class)
         chosen, handle = (None, None)
         if ranked:
             chosen, handle = self._dispatch_tokens(
-                ranked, prompt_ids, sampling, f"{rid}-a0", deadline_s)
+                ranked, prompt_ids, sampling, f"{rid}-a0", deadline_s,
+                slo_class=slo_class)
         if chosen is None:
             self._bump("sheds")
             err = handle  # last error from dispatch, or None when empty
@@ -360,12 +388,12 @@ class FleetRouter:
                 raise err
             raise OverloadedError(
                 f"no replica available ({err or 'fleet empty'})",
-                retriable=True, retry_after_s=1.0)
+                retriable=True, retry_after_s=1.0, slo_class=slo_class)
         self._account_affinity(digest, chosen, ranked)
 
         flight = _Flight(
             rid=rid, prompt_ids=list(prompt_ids), sampling=sampling,
-            deadline_s=deadline_s, digest=digest,
+            deadline_s=deadline_s, digest=digest, slo_class=slo_class,
             handle=RequestHandle(rid, eos_id=None), inner=handle,
             replica_id=chosen, dispatch_t0=time.monotonic())
         flight.handle._cancel_fn = lambda _rid: self._cancel_flight(flight)
@@ -404,11 +432,12 @@ class FleetRouter:
                     return self._finish_trimmed(fl)
                 replay = dataclasses.replace(
                     fl.sampling, max_tokens=remaining)
-                ranked = self._ranked(fl.digest, need_tokens=True)
+                ranked = self._ranked(fl.digest, need_tokens=True,
+                                      slo_class=fl.slo_class)
                 chosen, handle = self._dispatch_tokens(
                     ranked, fl.prompt_ids + fl.emitted, replay,
                     f"{fl.rid}-a{fl.attempts}", fl.deadline_s,
-                    exclude={fl.replica_id})
+                    exclude={fl.replica_id}, slo_class=fl.slo_class)
                 if chosen is None:
                     return self._fail(
                         fl, f"no healthy replica for failover ({handle})")
@@ -427,8 +456,12 @@ class FleetRouter:
         when the replica died and a failover should run."""
         inner = fl.inner
         first = not fl.emitted
+        # Hedging doubles device work for one request: never for batch
+        # traffic, and not while the primary reports brownout (degraded or
+        # worse) — the extra dispatch is exactly what it is shedding.
         if (self.hedge.enabled and first and fl.attempts == 0
-                and not fl.cancelled):
+                and not fl.cancelled and fl.slo_class != "batch"
+                and not self._replica_browned_out(fl.replica_id)):
             hedged = self._maybe_hedge(fl)
             if hedged is not None:
                 inner = hedged
@@ -459,6 +492,10 @@ class FleetRouter:
             fl.emitted.append(tok)
             fl.handle._push([tok], None)
 
+    def _replica_browned_out(self, replica_id: str) -> bool:
+        entry = self.registry.get(replica_id)
+        return entry is not None and entry.stats.brownout >= 1
+
     def _maybe_hedge(self, fl: _Flight) -> Optional[RequestHandle]:
         """Wait the hedge delay for a first token; past it, race a second
         replica.  Returns the winning inner handle (the loser is cancelled)
@@ -478,10 +515,11 @@ class FleetRouter:
             # else: stream ended inside the delay window (poll_token
             # re-armed the end sentinel for _consume).  Nothing to hedge.
             return None
-        ranked = self._ranked(fl.digest, need_tokens=True)
+        ranked = self._ranked(fl.digest, need_tokens=True,
+                              slo_class=fl.slo_class)
         chosen, hedge_handle = self._dispatch_tokens(
             ranked, fl.prompt_ids, fl.sampling, f"{fl.rid}-h",
-            fl.deadline_s, exclude={fl.replica_id})
+            fl.deadline_s, exclude={fl.replica_id}, slo_class=fl.slo_class)
         if chosen is None:
             return None
         self._bump("hedges_fired")
@@ -538,10 +576,12 @@ class FleetRouter:
 
     # -- text-level routing (HTTP replicas) ------------------------------
 
-    def _dispatch_text(self, digest: bytes, op):
+    def _dispatch_text(self, digest: bytes, op,
+                       slo_class: str = "standard"):
         """Run ``op(replica)`` on the first candidate that takes it;
         connection-level failures fall through to the next candidate."""
-        ranked = self._ranked(digest, need_tokens=False)
+        ranked = self._ranked(digest, need_tokens=False,
+                              slo_class=slo_class)
         last_exc: Exception | None = None
         for cand in ranked:
             entry = self.registry.get(cand.replica_id)
@@ -573,11 +613,14 @@ class FleetRouter:
             raise last_exc
         raise OverloadedError(
             f"no replica available ({last_exc or 'fleet empty'})",
-            retriable=True, retry_after_s=1.0)
+            retriable=True, retry_after_s=1.0, slo_class=slo_class)
 
-    def query(self, question: str) -> dict:
+    def query(self, question: str,
+              slo_class: str = "interactive") -> dict:
         rid, payload = self._dispatch_text(
-            self._text_digest(question), lambda r: r.query(question))
+            self._text_digest(question),
+            lambda r: r.query(question, slo_class=slo_class),
+            slo_class=slo_class)
         self.registry.note_done(rid, ok=True)
         return payload
 
@@ -600,7 +643,7 @@ class FleetRouter:
             out["replica"] = rid
         return out
 
-    def query_stream(self, question: str):
+    def query_stream(self, question: str, slo_class: str = "interactive"):
         """Returns (request_id, model, delta iterator).  The iterator fails
         over mid-stream: a new replica re-answers and the already-delivered
         character prefix is suppressed, so the caller sees a contiguous
@@ -608,7 +651,8 @@ class FleetRouter:
         same evidence; the token-level path is the strict contract)."""
         digest = self._text_digest(question)
         rid, (rep_rid, model, chunks) = self._dispatch_text(
-            digest, lambda r: r.query_stream(question))
+            digest, lambda r: r.query_stream(question, slo_class=slo_class),
+            slo_class=slo_class)
 
         def deltas():
             nonlocal rid, chunks
@@ -643,7 +687,10 @@ class FleetRouter:
                         raise
                     try:
                         rid, (_, _, chunks) = self._dispatch_text(
-                            digest, lambda r: r.query_stream(question))
+                            digest,
+                            lambda r: r.query_stream(question,
+                                                     slo_class=slo_class),
+                            slo_class=slo_class)
                     except OverloadedError:
                         self._bump("failed")
                         raise exc from None

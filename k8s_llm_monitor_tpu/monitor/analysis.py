@@ -68,20 +68,26 @@ class LLMBackend:
     name = "base"
 
     def generate(
-        self, prompt: str, max_tokens: int = 512, temperature: float = 0.1
+        self, prompt: str, max_tokens: int = 512, temperature: float = 0.1,
+        slo_class: str = "standard",
     ) -> str:
+        # ``slo_class`` is scheduling metadata for backends with an
+        # admission layer (LocalEngineBackend); remote/template backends
+        # accept and ignore it so callers can tag unconditionally.
         raise NotImplementedError
 
     def generate_stream(
-        self, prompt: str, max_tokens: int = 512, temperature: float = 0.1
+        self, prompt: str, max_tokens: int = 512, temperature: float = 0.1,
+        slo_class: str = "standard",
     ):
         """Yield text chunks.  Backends without true streaming yield the
         whole completion once (keeps the SSE route backend-agnostic)."""
         yield self.generate(prompt, max_tokens=max_tokens,
-                            temperature=temperature)
+                            temperature=temperature, slo_class=slo_class)
 
     def generate_constrained(self, prompt: str,
-                             temperature: float = 0.0) -> str:
+                             temperature: float = 0.0,
+                             slo_class: str = "standard") -> str:
         """Return Verdict JSON valid under ``diagnosis.grammar``'s schema.
 
         Default path for backends without token-level masking (remote
@@ -92,7 +98,8 @@ class LLMBackend:
         FSM-constrained decoding.
         """
         text = self.generate(prompt, max_tokens=512,
-                             temperature=temperature).strip()
+                             temperature=temperature,
+                             slo_class=slo_class).strip()
         try:
             parse_verdict(text)
             return text
@@ -122,7 +129,8 @@ class TemplateBackend(LLMBackend):
     name = "template"
 
     def generate(
-        self, prompt: str, max_tokens: int = 512, temperature: float = 0.1
+        self, prompt: str, max_tokens: int = 512, temperature: float = 0.1,
+        slo_class: str = "standard",
     ) -> str:
         issues = [
             line.strip("- ").strip()
@@ -142,7 +150,8 @@ class TemplateBackend(LLMBackend):
         )
 
     def generate_constrained(self, prompt: str,
-                             temperature: float = 0.0) -> str:
+                             temperature: float = 0.0,
+                             slo_class: str = "standard") -> str:
         """Deterministic grammar-valid verdict from the evidence sections —
         same extraction as ``generate``, rendered through the canonical
         serializer so it parses under the verdict grammar by construction."""
@@ -255,10 +264,20 @@ class LocalEngineBackend(LLMBackend):
     def engine(self):
         return self.service.engine
 
-    def _submit(self, prompt_ids, sampling):
+    def _submit(self, prompt_ids, sampling, slo_class: str = "standard"):
         if self.supervisor is not None:
-            return self.supervisor.submit(prompt_ids, sampling)
-        return self.service.submit(prompt_ids, sampling)
+            return self.supervisor.submit(prompt_ids, sampling,
+                                          slo_class=slo_class)
+        return self.service.submit(prompt_ids, sampling,
+                                   slo_class=slo_class)
+
+    def brownout_level(self) -> int:
+        """Current brownout rung (0=normal, 1=degraded, 2=draining) from
+        the live service's controller; 0 when no service is up."""
+        svc = self.service
+        if svc is None or getattr(svc, "brownout", None) is None:
+            return 0
+        return svc.brownout.level()
 
     @staticmethod
     def _install_verdict_grammar(engine, tokenizer) -> bool:
@@ -426,13 +445,15 @@ class LocalEngineBackend(LLMBackend):
                    engine_factory=engine_factory, lifecycle=lifecycle)
 
     def generate(
-        self, prompt: str, max_tokens: int = 512, temperature: float = 0.1
+        self, prompt: str, max_tokens: int = 512, temperature: float = 0.1,
+        slo_class: str = "standard",
     ) -> str:
         from k8s_llm_monitor_tpu.serving.engine import SamplingParams
 
         handle = self._submit(
             self.tokenizer.encode(prompt),
             SamplingParams(max_tokens=max_tokens, temperature=temperature),
+            slo_class=slo_class,
         )
         res = handle.result(timeout=self.GENERATION_TIMEOUT_S)
         if res.finish_reason == "error":
@@ -442,7 +463,8 @@ class LocalEngineBackend(LLMBackend):
         return self.tokenizer.decode(res.token_ids)
 
     def generate_constrained(self, prompt: str,
-                             temperature: float = 0.0) -> str:
+                             temperature: float = 0.0,
+                             slo_class: str = "standard") -> str:
         """True grammar-constrained decoding: the verdict FSM's per-step
         logit masks run inside the engine's on-device sampler, so the raw
         token stream IS the verdict JSON — no post-hoc repair.  Falls back
@@ -456,13 +478,15 @@ class LocalEngineBackend(LLMBackend):
             has_grammar = False
         if not has_grammar:
             return super().generate_constrained(prompt,
-                                                temperature=temperature)
+                                                temperature=temperature,
+                                                slo_class=slo_class)
         handle = self._submit(
             self.tokenizer.encode(prompt),
             # max_tokens=1 is a floor: submit() raises it to the grammar's
             # max accepting path so the verdict can always close.
             SamplingParams(max_tokens=1, temperature=temperature,
                            constrained=True),
+            slo_class=slo_class,
         )
         res = handle.result(timeout=self.GENERATION_TIMEOUT_S)
         if res.finish_reason == "error":
@@ -472,7 +496,8 @@ class LocalEngineBackend(LLMBackend):
         return self.tokenizer.decode(res.token_ids).strip()
 
     def generate_stream(
-        self, prompt: str, max_tokens: int = 512, temperature: float = 0.1
+        self, prompt: str, max_tokens: int = 512, temperature: float = 0.1,
+        slo_class: str = "standard",
     ):
         """Yield decoded text increments as tokens come off the device.
 
@@ -484,6 +509,7 @@ class LocalEngineBackend(LLMBackend):
         handle = self._submit(
             self.tokenizer.encode(prompt),
             SamplingParams(max_tokens=max_tokens, temperature=temperature),
+            slo_class=slo_class,
         )
         toks: list[int] = []
         emitted = ""
@@ -553,8 +579,10 @@ class OpenAICompatBackend(LLMBackend):
         return urllib.request.urlopen(req, timeout=self.cfg.timeout)
 
     def generate(
-        self, prompt: str, max_tokens: int = 512, temperature: float = 0.1
+        self, prompt: str, max_tokens: int = 512, temperature: float = 0.1,
+        slo_class: str = "standard",
     ) -> str:
+        # slo_class ignored: the remote endpoint has its own admission.
         body = json.dumps(
             {
                 "model": self.cfg.model,
@@ -789,7 +817,8 @@ class AnalysisEngine:
 
     # -- free-form NL question (the missing /api/v1/query) ---------------------
 
-    def query(self, question: str) -> AnalysisResponse:
+    def query(self, question: str,
+              slo_class: str = "interactive") -> AnalysisResponse:
         request_id = uuid.uuid4().hex[:12]
         try:
             ev = self.evidence.collect()
@@ -802,6 +831,7 @@ class AnalysisEngine:
                 prompt,
                 max_tokens=self.llm_cfg.max_tokens,
                 temperature=self.llm_cfg.temperature,
+                slo_class=slo_class,
             )
             return AnalysisResponse(
                 request_id=request_id,
@@ -826,7 +856,7 @@ class AnalysisEngine:
                 error_kind="internal",
             )
 
-    def query_stream(self, question: str):
+    def query_stream(self, question: str, slo_class: str = "interactive"):
         """Streaming variant of query(): returns (request_id, model_name,
         iterator of answer-text chunks).  Evidence collection happens up
         front (before the first chunk); generation streams from the backend
@@ -843,11 +873,12 @@ class AnalysisEngine:
             prompt,
             max_tokens=self.llm_cfg.max_tokens,
             temperature=self.llm_cfg.temperature,
+            slo_class=slo_class,
         )
         return request_id, self.backend.name, chunks
 
-    def query_session(self, question: str,
-                      session_id: str = "") -> AnalysisResponse:
+    def query_session(self, question: str, session_id: str = "",
+                      slo_class: str = "interactive") -> AnalysisResponse:
         """Multi-turn variant of ``query``: the cluster context is frozen
         at session creation and replayed verbatim as the prompt prefix on
         every follow-up, so the engine's PrefixCache (and fleet prefix
@@ -866,6 +897,7 @@ class AnalysisEngine:
                 prompt,
                 max_tokens=self.llm_cfg.max_tokens,
                 temperature=self.llm_cfg.temperature,
+                slo_class=slo_class,
             )
             session.record(question, answer)
             return AnalysisResponse(
@@ -892,8 +924,8 @@ class AnalysisEngine:
 
     # -- grammar-constrained verdicts -------------------------------------------
 
-    def diagnose(self, question: str,
-                 context: str | None = None) -> dict[str, Any]:
+    def diagnose(self, question: str, context: str | None = None,
+                 slo_class: str = "standard") -> dict[str, Any]:
         """One grammar-constrained root-cause verdict as a parsed dict.
 
         The contract callers (pipeline, ``_analyze_root_cause``) rely on:
@@ -913,7 +945,8 @@ class AnalysisEngine:
             "severity, component, root_cause, recommendation, confidence:\n"
         )
         text = self.backend.generate_constrained(
-            prompt, temperature=self.llm_cfg.temperature)
+            prompt, temperature=self.llm_cfg.temperature,
+            slo_class=slo_class)
         try:
             return parse_verdict(text)
         except GrammarError as exc:

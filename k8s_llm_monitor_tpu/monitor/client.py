@@ -429,6 +429,10 @@ class ApiClient:
     for SSE applies *between* reads so a healthy slow stream is fine.
     """
 
+    # Ceiling on any client-side retry hint, whatever the server says —
+    # a misconfigured replica must not park callers for minutes.
+    retry_cap_s = 30.0
+
     def __init__(self, base_url: str, *, connect_timeout_s: float = 2.0,
                  read_timeout_s: float = 30.0, backoff=None):
         import random as _random
@@ -440,6 +444,11 @@ class ApiClient:
         self.read_timeout_s = read_timeout_s
         self.backoff = backoff or Backoff(
             base_s=0.1, cap_s=2.0, attempts=3, rng=_random.Random(0))
+        # Decorrelated-jitter state for overload retry hints, per SLO
+        # class: consecutive 429s of the same class spread a thundering
+        # herd; any successful POST resets the whole map.
+        self._retry_rng = _random.Random(1)
+        self._retry_prev_s: dict[str, float] = {}
 
     # -- plumbing ------------------------------------------------------------
 
@@ -460,9 +469,24 @@ class ApiClient:
                                      headers=headers)
         return urllib.request.urlopen(req, timeout=timeout)  # noqa: S310
 
-    @staticmethod
-    def _overloaded_from(exc) -> "OverloadedError | None":
-        """Map a 429/503 reply carrying shed evidence to OverloadedError."""
+    def _retry_hint_s(self, server_hint_s: float, slo_class: str) -> float:
+        """Client-side retry delay from the server's per-class hint:
+        decorrelated jitter (``min(cap, uniform(hint, 3 * previous))``),
+        so N clients shed in the same step don't all come back on the
+        same tick, with ``retry_cap_s`` bounding runaway growth."""
+        base = max(0.05, server_hint_s)
+        prev = self._retry_prev_s.get(slo_class, base)
+        delay = min(self.retry_cap_s,
+                    self._retry_rng.uniform(base, max(base, prev * 3.0)))
+        self._retry_prev_s[slo_class] = delay
+        return delay
+
+    def _overloaded_from(self, exc) -> "OverloadedError | None":
+        """Map a 429/503 reply carrying shed evidence to OverloadedError.
+
+        The raised error's ``retry_after_s`` is the server's hint passed
+        through :meth:`_retry_hint_s` — NOT a flat fallback — so callers
+        that sleep on it honor the replica's per-class pushback."""
         import json as _json
 
         from k8s_llm_monitor_tpu.resilience.errors import OverloadedError
@@ -475,12 +499,18 @@ class ApiClient:
             payload = {}
         if exc.code == 503 and payload.get("error_kind") != "overloaded":
             return None
+        slo_class = str(payload.get("slo_class", ""))
+        try:
+            hint = float(payload.get("retry_after_s", 1.0))
+        except (TypeError, ValueError):
+            hint = 1.0
         return OverloadedError(
             payload.get("reason", f"HTTP {exc.code}"),
             queue_depth=int(payload.get("queue_depth", 0)),
             queue_tokens=int(payload.get("queue_tokens", 0)),
             retriable=exc.code == 429,
-            retry_after_s=float(payload.get("retry_after_s", 1.0)),
+            retry_after_s=self._retry_hint_s(hint, slo_class),
+            slo_class=slo_class,
         )
 
     def _get_json(self, path: str) -> dict[str, Any]:
@@ -515,7 +545,9 @@ class ApiClient:
 
         try:
             with self._open(path, body=body, timeout=timeout) as resp:
-                return _json.loads(resp.read().decode())
+                out = _json.loads(resp.read().decode())
+            self._retry_prev_s.clear()  # accepted: end the jitter streak
+            return out
         except urllib.error.HTTPError as exc:
             over = self._overloaded_from(exc)
             if over is not None:
@@ -555,15 +587,19 @@ class ApiClient:
 
     # -- queries (POST, never retried) ---------------------------------------
 
-    def query(self, question: str) -> dict[str, Any]:
-        return self._post_json("/api/v1/query", {"question": question},
+    def query(self, question: str,
+              slo_class: str = "") -> dict[str, Any]:
+        body: dict[str, Any] = {"question": question}
+        if slo_class:
+            body["slo_class"] = slo_class
+        return self._post_json("/api/v1/query", body,
                                timeout=self.read_timeout_s)
 
     def analyze(self, payload: dict[str, Any]) -> dict[str, Any]:
         return self._post_json("/api/v1/analyze", payload,
                                timeout=self.read_timeout_s)
 
-    def query_stream(self, question: str):
+    def query_stream(self, question: str, slo_class: str = ""):
         """POST /api/v1/query with ``stream: true``; returns
         ``(request_id, model, deltas)`` where ``deltas`` yields answer-text
         chunks.  Mid-stream socket death raises ``ApiConnectionError`` from
@@ -571,9 +607,11 @@ class ApiClient:
         import json as _json
         import urllib.error
 
+        body: dict[str, Any] = {"question": question, "stream": True}
+        if slo_class:
+            body["slo_class"] = slo_class
         try:
-            resp = self._open("/api/v1/query",
-                              body={"question": question, "stream": True},
+            resp = self._open("/api/v1/query", body=body,
                               timeout=self.read_timeout_s)
         except urllib.error.HTTPError as exc:
             over = self._overloaded_from(exc)
@@ -583,6 +621,7 @@ class ApiClient:
                 f"POST /api/v1/query: HTTP {exc.code}") from exc
         except (urllib.error.URLError, OSError) as exc:
             raise ApiConnectionError(f"POST /api/v1/query: {exc}") from exc
+        self._retry_prev_s.clear()  # admitted: end the jitter streak
 
         def events():
             import http.client

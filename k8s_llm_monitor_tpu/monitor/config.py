@@ -224,6 +224,10 @@ class FleetConfig:
     hedge_enabled: bool = False
     hedge_min_delay_s: float = 0.05
     hedge_fixed_delay_s: float = 0.0
+    # SLO-class routing (resilience/slo.py): batch requests only spill to
+    # a non-affinity replica whose load score is below this fraction of
+    # capacity; interactive requests always route least-loaded.
+    batch_spill_threshold: float = 0.75
 
 
 @dataclass

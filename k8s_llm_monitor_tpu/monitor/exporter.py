@@ -163,7 +163,10 @@ _HEALTH_STATES = ("healthy", "degraded", "draining", "unhealthy")
 
 
 def _resilience_metrics(w: _Writer, engine, service) -> None:
-    """Health state machine + failure-recovery counters (PR 2)."""
+    """Health state machine + failure-recovery counters (PR 2), plus the
+    SLO-class admission/eviction/brownout gauges (resilience/slo.py)."""
+    from k8s_llm_monitor_tpu.resilience.slo import BROWNOUT_NAMES, SLO_CLASSES
+
     if service is not None:
         state = service.health.state()
         w.metric("health_state", "gauge",
@@ -173,6 +176,25 @@ def _resilience_metrics(w: _Writer, engine, service) -> None:
         w.metric("sheds_total", "counter",
                  "Submissions refused by load shedding",
                  [("", service.health.sheds)])
+        w.metric("shed_total", "counter",
+                 "Submissions refused by class-aware load shedding, "
+                 "by SLO class",
+                 [(f'{{class="{c}"}}',
+                   service.shed_count_by_class.get(c, 0))
+                  for c in SLO_CLASSES])
+        bsnap = service.brownout.snapshot()
+        w.metric("brownout_state", "gauge",
+                 "Brownout ladder rung (1 = current rung); degraded "
+                 "disables hedging/spec-decode and clamps batch budgets, "
+                 "draining pauses diagnosis triggers",
+                 [(f'{{state="{s}"}}', 1 if i == bsnap["level"] else 0)
+                  for i, s in enumerate(BROWNOUT_NAMES)])
+        w.metric("brownout_escalations_total", "counter",
+                 "Brownout rung increases (immediate on health decline)",
+                 [("", bsnap["escalations"])])
+        w.metric("brownout_recoveries_total", "counter",
+                 "Brownout rung decreases (one rung per recovery dwell)",
+                 [("", bsnap["recoveries"])])
     w.metric("engine_watchdog_trips_total", "counter",
              "Dispatch watchdog expirations (pipeline resets)",
              [("", engine.watchdog_trips)])
@@ -189,6 +211,33 @@ def _resilience_metrics(w: _Writer, engine, service) -> None:
              "EMA of queue wait before a request wins a slot "
              "(load-shedding signal)",
              [("", round(engine.slot_wait_ema_s, 6))])
+    # Per-class admission/latency EMAs.  A class with no sample yet emits
+    # an explicit NaN (the constrained_decode_overhead_ms pattern): the
+    # fleet router proxies replica /metrics, so an absent label would
+    # silently mix "never measured" into the 0.0 population across
+    # replicas.  Counters stay 0-valued — zero events IS the measurement.
+    w.metric("queue_wait_ms", "gauge",
+             "EMA of queue wait before a slot, by SLO class "
+             "(NaN = no admission of this class yet)",
+             [(f'{{class="{c}"}}',
+               round(engine.slot_wait_ema_by_class[c] * 1000.0, 3)
+               if c in engine.slot_wait_ema_by_class else float("nan"))
+              for c in SLO_CLASSES])
+    w.metric("engine_ttft_ema_seconds", "gauge",
+             "EMA of time to first token, by SLO class "
+             "(NaN = no completion of this class yet)",
+             [(f'{{class="{c}"}}',
+               round(engine.ttft_ema_by_class[c], 6)
+               if c in engine.ttft_ema_by_class else float("nan"))
+              for c in SLO_CLASSES])
+    w.metric("preemptions_total", "counter",
+             "Recompute-preemptions (involuntary KV pressure + voluntary "
+             "class eviction), by evicted lane's SLO class",
+             [(f'{{class="{c}"}}', engine.preemptions_by_class.get(c, 0))
+              for c in SLO_CLASSES])
+    w.metric("engine_brownout_clamps_total", "counter",
+             "Batch max_tokens clamps applied while degraded or worse",
+             [("", engine.brownout_clamps)])
 
 
 _LIFECYCLE_STATES = ("serving", "rebuilding", "terminating", "stopped",

@@ -41,6 +41,7 @@ from k8s_llm_monitor_tpu.monitor.models import (
 )
 from k8s_llm_monitor_tpu.monitor.network import NetworkAnalyzer
 from k8s_llm_monitor_tpu.resilience.errors import OverloadedError
+from k8s_llm_monitor_tpu.resilience.slo import normalize_slo_class
 
 logger = logging.getLogger("monitor.server")
 
@@ -188,6 +189,9 @@ class MonitorServer:
             snap["engine"] = {
                 "queue_depth": engine.queue_depth,
                 "queue_tokens": engine.queue_tokens,
+                "queue_tokens_by_class": engine.queue_tokens_by_class(),
+                "brownout": (engine.brownout()
+                             if engine.brownout is not None else 0),
                 "busy_slots": engine.active_slots,
                 "total_slots": engine.ecfg.max_slots,
                 "prefix_deferrals": engine.prefix_deferrals,
@@ -310,6 +314,7 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
                     "retry_after_s": exc.retry_after_s,
                     "queue_depth": exc.queue_depth,
                     "queue_tokens": exc.queue_tokens,
+                    "slo_class": exc.slo_class,
                     "timestamp": _now(),
                 },
                 status=429 if exc.retriable else 503,
@@ -573,8 +578,15 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
             question = (body.get("question") or "").strip()
             if not question:
                 return self._send_error_text("question is required", 400)
+            try:
+                # Operator-facing queries default to the interactive lane;
+                # callers may opt down to "standard" or "batch".
+                slo_class = normalize_slo_class(
+                    str(body.get("slo_class") or ""), default="interactive")
+            except ValueError as exc:
+                return self._send_error_text(str(exc), 400)
             if body.get("stream"):
-                return self._stream_query(question)
+                return self._stream_query(question, slo_class)
             # Multi-turn follow-ups: "session_id" (even "", which mints a
             # new session) pins the conversation to one frozen cluster
             # context whose token prefix replays every turn — PrefixCache
@@ -584,9 +596,10 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
                     return self._send_error_text(
                         "sessions are not supported on this role", 400)
                 resp = srv.analysis.query_session(
-                    question, str(body.get("session_id") or ""))
+                    question, str(body.get("session_id") or ""),
+                    slo_class=slo_class)
             else:
-                resp = srv.analysis.query(question)
+                resp = srv.analysis.query(question, slo_class=slo_class)
             self._send_json(resp, status=200 if resp.status == "success" else 500)
 
         def h_diagnoses(self) -> None:
@@ -627,13 +640,15 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
                 "Diagnosis pipeline not available - running in development "
                 "mode", 503)
 
-        def _stream_query(self, question: str) -> None:
+        def _stream_query(self, question: str,
+                          slo_class: str = "interactive") -> None:
             """Server-sent events: one `data:` JSON per answer-text delta as
             tokens come off the device, then a final done event.  TTFT is
             real for clients here — the first delta arrives while the rest
             of the answer is still decoding."""
             try:
-                request_id, model, chunks = srv.analysis.query_stream(question)
+                request_id, model, chunks = srv.analysis.query_stream(
+                    question, slo_class=slo_class)
             except OverloadedError as exc:  # headers not sent yet: 429/503
                 return self._send_overloaded(exc)
             except Exception as exc:  # noqa: BLE001 — before headers: 500
@@ -998,8 +1013,12 @@ def build_server(
         # encoder for context assembly.
         from k8s_llm_monitor_tpu.diagnosis.pipeline import DiagnosisPipeline
 
+        # Brownout coupling: at DRAINING the pipeline pauses new triggers
+        # (the backend exposes the rung only when it runs a local engine).
+        brownout = getattr(llm_backend, "brownout_level", None)
         diagnosis = DiagnosisPipeline(
-            analysis, config.diagnosis, embedder=detector)
+            analysis, config.diagnosis, embedder=detector,
+            brownout=brownout)
     return MonitorServer(
         config=config,
         client=client,

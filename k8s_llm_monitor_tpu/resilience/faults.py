@@ -35,6 +35,7 @@ FAULT_POINTS: frozenset[str] = frozenset({
     "prefill_dispatch",     # batched prefill / chunk-round program call raises
     "decode_stuck",         # decode result never becomes ready (watchdog food)
     "slow_host_callback",   # reconcile-side host work sleeps delay_s
+    "lane_eviction",        # class-ordered preemption raises mid-eviction
     # serving/kv_cache.py — allocator
     "alloc_exhaustion",     # alloc/extend raise OutOfBlocks despite free pages
     # serving/service.py — step loop

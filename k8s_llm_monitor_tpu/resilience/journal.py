@@ -79,6 +79,8 @@ class JournaledRequest:
     arrival_unix: float = 0.0
     emitted: list[int] = field(default_factory=list)
     completed: bool = False
+    # SLO class (resilience/slo.py); pre-class WALs default to "standard".
+    slo_class: str = "standard"
 
 
 def _pack(rtype: int, payload: dict[str, Any]) -> bytes:
@@ -166,6 +168,7 @@ def scan_journal(directory: str | Path) -> tuple[dict[str, JournaledRequest], bo
                 req.sampling = dict(payload.get("sampling") or {})
                 req.deadline_s = float(payload.get("deadline_s", 0.0))
                 req.arrival_unix = float(payload.get("arrival", 0.0))
+                req.slo_class = str(payload.get("slo_class", "standard"))
             elif rtype == PROGRESS:
                 req = requests.get(rid)
                 if req is None:
@@ -302,7 +305,8 @@ class RequestJournal:
 
     def log_admit(self, request_id: str, prompt_ids: list[int],
                   sampling: Any, deadline_s: float = 0.0,
-                  arrival_unix: float | None = None) -> None:
+                  arrival_unix: float | None = None,
+                  slo_class: str = "standard") -> None:
         """Journal an accepted request BEFORE it reaches the engine
         (write-ahead).  ``sampling`` may be a SamplingParams dataclass or a
         plain dict."""
@@ -314,6 +318,7 @@ class RequestJournal:
             "sampling": sampling or {},
             "deadline_s": float(deadline_s),
             "arrival": time.time() if arrival_unix is None else arrival_unix,
+            "slo_class": slo_class,
         }
         with self._lock:
             self._live_refs.setdefault(request_id, set()).add(self._seg_index)

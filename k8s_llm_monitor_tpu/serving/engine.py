@@ -62,6 +62,7 @@ import numpy as np
 from k8s_llm_monitor_tpu.models import llama
 from k8s_llm_monitor_tpu.models.config import ModelConfig
 from k8s_llm_monitor_tpu.resilience.faults import FaultError, get_injector
+from k8s_llm_monitor_tpu.resilience.slo import DEFAULT_CLASS, SLO_RANK
 from k8s_llm_monitor_tpu.ops.sampling import (
     fsm_advance,
     fsm_mask_logits,
@@ -120,6 +121,10 @@ class GenerationRequest:
     # (watchdog trip / dispatch failure); bounded by
     # EngineConfig.max_requeues, then the request fails with the cause.
     requeues: int = 0
+    # SLO class (resilience/slo.py): "interactive" | "standard" | "batch".
+    # Host-side scheduling metadata only — orders admission, shedding, and
+    # eviction; never enters a traced program (zero recompiles).
+    slo_class: str = DEFAULT_CLASS
 
 
 @dataclasses.dataclass
@@ -223,6 +228,16 @@ class EngineConfig:
     # retriable OverloadedError at submit time.
     shed_queue_tokens: int = 0
     shed_slot_wait_s: float = 0.0
+    # --- SLO classes (resilience/slo.py) ------------------------------
+    # Voluntary class-ordered preemptions per step(): with no free slot
+    # and a strictly higher-class request queued, the engine evicts the
+    # lowest-class running lane (recompute-requeue, byte-exact resumption)
+    # up to this budget.  0 disables voluntary eviction; page-pressure
+    # eviction inside the decode path still runs.
+    max_preemptions: int = 2
+    # Brownout clamp on batch-class max_tokens applied at admission while
+    # the ladder sits at DEGRADED or worse; 0 disables the clamp.
+    brownout_batch_max_tokens: int = 64
 
 
 class _Slot:
@@ -553,6 +568,8 @@ class InferenceEngine:
         self.steps = 0
         self.prefills = 0
         self.preemptions = 0
+        self.preemptions_by_class: dict[str, int] = {}
+        self.brownout_clamps = 0
         self._chunks_since_decode = 0
         # Resilience state (docs/resilience.md).  ``health`` is an optional
         # HealthMonitor attached by EngineService; the engine records
@@ -560,6 +577,9 @@ class InferenceEngine:
         # state machine sees events the moment they happen.
         self._faults = get_injector()
         self.health = None
+        # Optional brownout-level source (callable -> int 0..2), attached
+        # by EngineService; consulted host-side only, never traced.
+        self.brownout = None
         self.dispatch_failures = 0
         self.consecutive_dispatch_failures = 0
         self.watchdog_trips = 0
@@ -569,6 +589,11 @@ class InferenceEngine:
         # EMA of submit->admission wait; a shed signal when slots churn
         # slower than the arrival rate.
         self.slot_wait_ema_s = 0.0
+        # Per-class admission-wait and TTFT EMAs (exporter gauges).  Keys
+        # appear on first observation, so the exporter can NaN-mark
+        # classes that never carried traffic instead of mixing populations.
+        self.slot_wait_ema_by_class: dict[str, float] = {}
+        self.ttft_ema_by_class: dict[str, float] = {}
         # TTFT histogram (Prometheus semantics: cumulative le buckets +
         # sum/count), observed once per request at admission reconcile.
         self.ttft_buckets: tuple[float, ...] = (
@@ -712,21 +737,46 @@ class InferenceEngine:
         """Prompt-token backlog waiting for admission (shed signal)."""
         return sum(len(r.prompt_ids) for r in self._pending)
 
+    def queue_tokens_by_class(self) -> dict[str, int]:
+        """Prompt-token backlog per SLO class (fleet stats + class-aware
+        shedding).  Only classes with queued work appear as keys."""
+        out: dict[str, int] = {}
+        for r in self._pending:
+            out[r.slo_class] = out.get(r.slo_class, 0) + len(r.prompt_ids)
+        return out
+
     @property
     def active_slots(self) -> int:
         return sum(1 for s in self._slots if s is not None)
 
-    def should_shed(self) -> str:
-        """Non-empty reason when new work should be shed (admission
-        control): queue-token backlog or admission-wait EMA above the
-        configured thresholds.  The caller (EngineService.submit) turns
-        this into a retriable ``OverloadedError``; the engine itself never
-        rejects — by the time work reaches ``submit()`` the caller has
-        already been told to back off."""
+    def should_shed(self, slo_class: str = DEFAULT_CLASS) -> str:
+        """Non-empty reason when new work of ``slo_class`` should be shed
+        (admission control): queue-token backlog or admission-wait EMA
+        above the configured thresholds.  The caller (EngineService.submit)
+        turns this into a retriable ``OverloadedError``; the engine itself
+        never rejects — by the time work reaches ``submit()`` the caller
+        has already been told to back off.
+
+        Shedding is class-ordered: a request is charged only for backlog
+        of its own class and above (queued lower-class tokens would be
+        admitted *after* it, so they are not load it waits behind), and no
+        request is shed while strictly lower-class work is queued — that
+        work sheds/evicts first, so ``interactive`` is never refused while
+        ``batch`` waits.  With single-class traffic (everything at the
+        default) this reduces exactly to the flat thresholds."""
         ec = self.ecfg
-        if 0 < ec.shed_queue_tokens <= self.queue_tokens:
-            return (f"queue token backlog {self.queue_tokens} >= "
-                    f"{ec.shed_queue_tokens}")
+        rank = SLO_RANK.get(slo_class, SLO_RANK[DEFAULT_CLASS])
+        by_class = self.queue_tokens_by_class()
+        ahead = sum(t for c, t in by_class.items()
+                    if SLO_RANK.get(c, SLO_RANK[DEFAULT_CLASS]) <= rank)
+        lower_queued = any(
+            t > 0 and SLO_RANK.get(c, SLO_RANK[DEFAULT_CLASS]) > rank
+            for c, t in by_class.items())
+        if lower_queued:
+            return ""
+        if 0 < ec.shed_queue_tokens <= ahead:
+            return (f"queue token backlog {ahead} >= "
+                    f"{ec.shed_queue_tokens} for class {slo_class}")
         if 0 < ec.shed_slot_wait_s <= self.slot_wait_ema_s:
             return (f"admission wait EMA {self.slot_wait_ema_s:.2f}s >= "
                     f"{ec.shed_slot_wait_s:.2f}s")
@@ -759,6 +809,7 @@ class InferenceEngine:
         results down to the dispatch-ahead window (or fully, when there is
         nothing left to dispatch)."""
         self._enforce_deadlines()
+        self._schedule_classes()
         dispatched = 0
         rounds = 0
         while rounds < self.ecfg.max_admission_rounds and self._admit_round():
@@ -853,13 +904,117 @@ class InferenceEngine:
 
     def _note_admission_wait(self, req: GenerationRequest) -> None:
         """Track how long requests sit queued before winning a slot — the
-        EMA backs the ``shed_slot_wait_s`` load-shedding signal."""
+        EMA backs the ``shed_slot_wait_s`` load-shedding signal; the
+        per-class EMAs back the exporter's ``queue_wait_ms{class}``."""
         wait = time.monotonic() - req.submit_time
         if self.slot_wait_ema_s == 0.0:
             self.slot_wait_ema_s = wait
         else:
             self.slot_wait_ema_s = (
                 0.9 * self.slot_wait_ema_s + 0.1 * wait)
+        prev = self.slot_wait_ema_by_class.get(req.slo_class)
+        self.slot_wait_ema_by_class[req.slo_class] = (
+            wait if prev is None else 0.9 * prev + 0.1 * wait)
+
+    # -- SLO-class scheduling (resilience/slo.py) ------------------------
+
+    def _brownout_level(self) -> int:
+        """Current brownout ladder level; 0 when no controller attached.
+        Host-side scheduling input only — never read inside a traced
+        program."""
+        if self.brownout is None:
+            return 0
+        try:
+            return int(self.brownout())
+        except Exception:  # noqa: BLE001 — a dying controller must not wedge the step loop
+            return 0
+
+    def _clamp_for_brownout(self, req: GenerationRequest) -> None:
+        """At DEGRADED or worse, clamp batch-class generation budgets so
+        bulk work stops monopolizing decode bandwidth.  Applied at
+        admission — lanes already running keep their budget.  Constrained
+        requests are exempt: the grammar's forced EOS needs its max
+        accepting path reachable."""
+        cap = self.ecfg.brownout_batch_max_tokens
+        if (cap <= 0 or req.slo_class != "batch"
+                or req.sampling.constrained
+                or req.sampling.max_tokens <= cap
+                or self._brownout_level() < 1):
+            return
+        req.sampling = dataclasses.replace(req.sampling, max_tokens=cap)
+        self.brownout_clamps += 1
+
+    def _eviction_victim(self, worse_than: int = -1) -> int:
+        """Running lane to evict under pressure: lowest SLO class first,
+        youngest within a class (so the oldest protected work always makes
+        progress).  ``worse_than`` >= 0 restricts candidates to lanes
+        strictly underranking it — voluntary preemption must only evict
+        lanes a queued request outranks.  Cancelled lanes are skipped
+        (preempting one would resurrect a request nobody is waiting for).
+        Returns -1 when no lane qualifies."""
+        best = -1
+        best_key: tuple[int, float] | None = None
+        for j, sl in enumerate(self._slots):
+            if sl is None or sl.retired or sl.cancel_requested:
+                continue
+            r = SLO_RANK.get(sl.req.slo_class, SLO_RANK[DEFAULT_CLASS])
+            if 0 <= worse_than < r or worse_than < 0:
+                key = (r, sl.req.submit_time)
+                if best_key is None or key > best_key:
+                    best, best_key = j, key
+        return best
+
+    def _schedule_classes(self) -> None:
+        """Class-priority scheduling, all host-side (nothing traced):
+        stable-sort the pending queue by SLO rank (FIFO preserved within a
+        class — preempted requests pushed to the queue head stay first in
+        their class), then voluntarily evict lower-class running lanes
+        while a strictly higher-class request waits with no free slot,
+        bounded by ``max_preemptions`` per step."""
+        self._sort_pending_by_class()
+        budget = self.ecfg.max_preemptions
+        preempted = 0
+        while preempted < budget and self._pending:
+            if any(s is None for s in self._slots):
+                return  # a free slot exists; plain admission will fill it
+            best = min(SLO_RANK.get(r.slo_class, SLO_RANK[DEFAULT_CLASS])
+                       for r in self._pending)
+            if self._eviction_victim(worse_than=best) < 0:
+                return
+            # Recompute-preemption requires reconciled lanes: the folded
+            # prompt must contain every sampled token (byte-exactness).
+            self._reconcile_all()
+            if any(s is None for s in self._slots):
+                continue  # the drain freed a slot; no eviction needed
+            victim = self._eviction_victim(worse_than=best)
+            if victim < 0:
+                return
+            try:
+                self._faults.maybe_raise("lane_eviction")
+            except FaultError as exc:
+                # Eviction path died mid-ladder: running lanes are
+                # untouched and every already-preempted request is safely
+                # queued — record the failure and stop evicting this step.
+                self._record_dispatch_failure(exc)
+                return
+            self._preempt(victim)
+            # The victim was requeued at the queue head; re-sort so the
+            # higher-class request it was evicted for is admitted first
+            # (otherwise the victim reclaims its own slot and the next
+            # step evicts it again — a preemption livelock).
+            self._sort_pending_by_class()
+            preempted += 1
+
+    def _sort_pending_by_class(self) -> None:
+        """Stable-sort the pending queue by SLO rank (FIFO preserved
+        within a class).  Skipped for single-class traffic: order is
+        already FIFO and the sort would be pure overhead."""
+        if len(self._pending) > 1 and len(
+                {r.slo_class for r in self._pending}) > 1:
+            self._pending = collections.deque(sorted(
+                self._pending,
+                key=lambda r: SLO_RANK.get(
+                    r.slo_class, SLO_RANK[DEFAULT_CLASS])))
 
     def _requeue_or_fail(self, slot_idx: int, cause: str) -> None:
         """Recovery path for a slot whose in-flight work was lost (pipeline
@@ -1206,6 +1361,7 @@ class InferenceEngine:
                 self._pending.appendleft(req)
                 break
             self._note_admission_wait(req)
+            self._clamp_for_brownout(req)
             if L - shared_toks > top:
                 # Long suffix: occupy a slot in *prefilling* state — its
                 # chunks stream one batched round per engine step
@@ -1912,6 +2068,11 @@ class InferenceEngine:
         # unmasked positions, so accepted drafts could violate the grammar.
         spec = ec.spec_k > 0 and not any(
             s.req.sampling.constrained for _, s in lanes)
+        if spec and self._brownout_level() >= 1:
+            # DEGRADED or worse: a verify forward costs more than a fused
+            # step and serializes the pipeline — the brownout ladder sheds
+            # the speculative gamble before it sheds any request.
+            spec = False
         if spec:
             spec = self._spec_accept.should_draft(self._spec_class(lanes))
         if spec:
@@ -1933,15 +2094,10 @@ class InferenceEngine:
             K = 1 << (kmax.bit_length() - 1)
 
         # Ensure pages for each lane's next min(K, remaining) KV writes.  On
-        # pressure, drain speculation (so preemption sees reconciled state)
-        # and evict the *youngest* active slot so the oldest always makes
-        # progress; the youngest may be the failing one, evicting itself.
-        def _youngest_active() -> int:
-            return max(
-                (j for j, sl in enumerate(self._slots) if sl is not None),
-                key=lambda j: self._slots[j].req.submit_time,
-            )
-
+        # pressure, drain in-flight work (so preemption sees reconciled
+        # state) and evict the lowest-class, youngest active slot so the
+        # oldest protected work always makes progress; the victim may be
+        # the failing lane itself, evicting itself.
         for i, s in sorted(lanes, key=lambda t: t[1].req.submit_time):
             if self._slots[i] is not s or s.retired:
                 continue  # evicted/retired during the pressure loop below
@@ -1963,7 +2119,18 @@ class InferenceEngine:
                         self.allocator.extend(s.blocks, s.ctx_pred + steps_i)
                         break
                     except OutOfBlocks:
-                        victim = _youngest_active()
+                        victim = self._eviction_victim()
+                        if victim < 0:
+                            victim = i  # only cancelled lanes left: self-evict
+                        try:
+                            self._faults.maybe_raise("lane_eviction")
+                        except FaultError as exc:
+                            # Mid-eviction failure: fall back to evicting
+                            # the requesting lane itself — always safe
+                            # (recompute-requeue) and never leaves an
+                            # unextended lane in the dispatch.
+                            self._record_dispatch_failure(exc)
+                            victim = i
                         self._preempt(victim)
                         if victim == i:
                             break
@@ -2194,7 +2361,7 @@ class InferenceEngine:
                 s.generated.append(tok)
                 if req.first_token_time == 0.0:
                     req.first_token_time = now
-                    self._observe_ttft(now - req.submit_time)
+                    self._observe_ttft(now - req.submit_time, req.slo_class)
                 s.first_token_time = req.first_token_time
                 self._emit(req, [tok])
                 if self._is_finished(s) or s.cancel_requested:
@@ -2216,7 +2383,8 @@ class InferenceEngine:
                                             and s.inflight_decode == 0):
                     self._retire(slot_idx)
 
-    def _observe_ttft(self, ttft_s: float) -> None:
+    def _observe_ttft(self, ttft_s: float,
+                      slo_class: str = DEFAULT_CLASS) -> None:
         for i, le in enumerate(self.ttft_buckets):
             if ttft_s <= le:
                 self.ttft_counts[i] += 1
@@ -2225,6 +2393,9 @@ class InferenceEngine:
             self.ttft_counts[-1] += 1
         self.ttft_sum += ttft_s
         self.ttft_count += 1
+        prev = self.ttft_ema_by_class.get(slo_class)
+        self.ttft_ema_by_class[slo_class] = (
+            ttft_s if prev is None else 0.9 * prev + 0.1 * ttft_s)
 
     def _is_finished(self, s: _Slot) -> bool:
         return bool(s.generated) and (
@@ -2289,3 +2460,5 @@ class InferenceEngine:
         self._cap_request(req)  # re-apply the submit-time capacity cap
         self._pending.appendleft(req)
         self.preemptions += 1
+        self.preemptions_by_class[req.slo_class] = (
+            self.preemptions_by_class.get(req.slo_class, 0) + 1)

@@ -29,6 +29,11 @@ from k8s_llm_monitor_tpu.resilience.errors import OverloadedError
 from k8s_llm_monitor_tpu.resilience.faults import get_injector
 from k8s_llm_monitor_tpu.resilience.health import HealthMonitor
 from k8s_llm_monitor_tpu.resilience.retry import Backoff
+from k8s_llm_monitor_tpu.resilience.slo import (
+    DEFAULT_CLASS,
+    BrownoutController,
+    normalize_slo_class,
+)
 from k8s_llm_monitor_tpu.serving.engine import (
     GenerationRequest,
     GenerationResult,
@@ -135,7 +140,7 @@ class RequestHandle:
 
 
 @guarded_by("_handles_lock", "_draining", "_dead", "shed_count",
-            "_shed_streak")
+            "shed_count_by_class", "_shed_streaks")
 class EngineService:
     """Background step-loop over an ``InferenceEngine`` with thread-safe
     submission.  The loop thread is the only toucher of engine state; callers
@@ -150,7 +155,8 @@ class EngineService:
 
     def __init__(self, engine: InferenceEngine,
                  health: HealthMonitor | None = None,
-                 on_death: Callable[[str], None] | None = None):
+                 on_death: Callable[[str], None] | None = None,
+                 brownout: BrownoutController | None = None):
         self.engine = engine
         engine.token_sink = self._sink
         # One health monitor per service: the engine reports dispatch
@@ -158,6 +164,11 @@ class EngineService:
         # and /health + /readyz read it.
         self.health = health or HealthMonitor()
         engine.health = self.health
+        # Brownout ladder over the health state (resilience/slo.py): the
+        # engine consults the level for spec-decode gating and batch
+        # max_tokens clamping; the fleet/router tiers read it from stats.
+        self.brownout = brownout or BrownoutController(self.health.state)
+        engine.brownout = self.brownout.level
         self.on_death = on_death
         self.observer: Callable[
             [str, list[int], Optional[GenerationResult]], None] | None = None
@@ -171,7 +182,11 @@ class EngineService:
         self._wake = threading.Event()
         self._draining = False
         self.shed_count = 0
-        self._shed_streak = 0  # consecutive sheds -> Retry-After hint
+        self.shed_count_by_class: dict[str, int] = {}
+        # Consecutive sheds per SLO class -> per-class Retry-After hints:
+        # a shed batch caller backs off on the batch streak while the
+        # interactive lane's hint stays at the base delay.
+        self._shed_streaks: dict[str, int] = {}
         self._shed_backoff = Backoff(base_s=1.0, cap_s=8.0, jitter=0.0)
         self._dead: str | None = None  # set when the step loop dies
         # Step-loop liveness beat: refreshed every iteration; a stale beat
@@ -193,13 +208,18 @@ class EngineService:
 
     # -- submission -----------------------------------------------------
 
-    def _record_shed(self) -> float:
+    def _record_shed(self, slo_class: str = DEFAULT_CLASS) -> float:
         """Bump shed counters; returns a Retry-After hint that backs off
-        with consecutive sheds (resets on the next successful admit)."""
+        with consecutive sheds *of this class* (reset by the class's next
+        successful admit) — overloaded batch lanes escalate their hint
+        without inflating the interactive lane's."""
         with self._handles_lock:
             self.shed_count += 1
-            self._shed_streak += 1
-            streak = self._shed_streak
+            self.shed_count_by_class[slo_class] = (
+                self.shed_count_by_class.get(slo_class, 0) + 1)
+            self._shed_streaks[slo_class] = (
+                self._shed_streaks.get(slo_class, 0) + 1)
+            streak = self._shed_streaks[slo_class]
         self.health.record_shed()
         return self._shed_backoff.delay(min(streak - 1, 4))
 
@@ -211,6 +231,7 @@ class EngineService:
         deadline_s: float = 0.0,
         force: bool = False,
         handle: RequestHandle | None = None,
+        slo_class: str = DEFAULT_CLASS,
     ) -> RequestHandle:
         """Admit a generation request.
 
@@ -218,8 +239,10 @@ class EngineService:
         request was already accepted once and must not be refused on its
         way back in).  ``handle`` re-installs an existing RequestHandle
         under the same request id so a replayed request keeps streaming to
-        the original caller with no token gap.
+        the original caller with no token gap.  ``slo_class`` orders
+        admission, shedding, and eviction (resilience/slo.py).
         """
+        slo_class = normalize_slo_class(slo_class)
         with self._handles_lock:
             dead = self._dead
             draining = self._draining
@@ -229,20 +252,22 @@ class EngineService:
             if draining or self._stop.is_set():
                 # Not retriable *here* — this replica is going away; the
                 # client should retry against another replica.
-                hint = self._record_shed()
+                hint = self._record_shed(slo_class)
                 raise OverloadedError("draining", retriable=False,
-                                      retry_after_s=hint)
-            reason = self.engine.should_shed()
+                                      retry_after_s=hint,
+                                      slo_class=slo_class)
+            reason = self.engine.should_shed(slo_class)
             if reason:
-                hint = self._record_shed()
+                hint = self._record_shed(slo_class)
                 raise OverloadedError(
                     reason,
                     queue_depth=self.engine.queue_depth,
                     queue_tokens=self.engine.queue_tokens,
-                    retry_after_s=hint)
+                    retry_after_s=hint,
+                    slo_class=slo_class)
         self.health.record_admit()
         with self._handles_lock:
-            self._shed_streak = 0
+            self._shed_streaks.pop(slo_class, None)
         if request_id is None:
             request_id = f"svc-{next(self._ids)}"
         if handle is None:
@@ -258,6 +283,7 @@ class EngineService:
             prompt_ids=list(prompt_ids),
             sampling=sampling or SamplingParams(),
             deadline_s=deadline_s,
+            slo_class=slo_class,
         ))
         self._wake.set()
         return handle

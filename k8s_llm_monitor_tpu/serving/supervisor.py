@@ -55,6 +55,7 @@ from k8s_llm_monitor_tpu.resilience.journal import (
     RequestJournal,
 )
 from k8s_llm_monitor_tpu.resilience.retry import Backoff
+from k8s_llm_monitor_tpu.resilience.slo import DEFAULT_CLASS
 from k8s_llm_monitor_tpu.serving.engine import (
     GenerationResult,
     InferenceEngine,
@@ -82,6 +83,7 @@ class _Tracked:
     arrival_unix: float
     emitted: list[int] = field(default_factory=list)
     handle: Optional[RequestHandle] = None
+    slo_class: str = DEFAULT_CLASS
 
 
 def _sampling_from_dict(data: dict) -> SamplingParams:
@@ -190,6 +192,7 @@ class EngineSupervisor:
         sampling: SamplingParams | None = None,
         request_id: str | None = None,
         deadline_s: float = 0.0,
+        slo_class: str = DEFAULT_CLASS,
     ) -> RequestHandle:
         """Journal (write-ahead), track, and admit one request."""
         with self._lock:
@@ -206,18 +209,19 @@ class EngineSupervisor:
             # Unique across process restarts sharing one journal dir.
             request_id = f"req-{self._pid}-{next(self._ids)}"
         tracked = _Tracked(list(prompt_ids), sampling, deadline_s,
-                           time.time())
+                           time.time(), slo_class=slo_class)
         # Track before the engine can emit a single token for this id, and
         # journal before the engine can accept it (write-AHEAD).
         with self._lock:
             self._tracked[request_id] = tracked
         if self.journal is not None:
             self.journal.log_admit(request_id, prompt_ids, sampling,
-                                   deadline_s, tracked.arrival_unix)
+                                   deadline_s, tracked.arrival_unix,
+                                   slo_class=slo_class)
         try:
             handle = self.service.submit(
                 prompt_ids, sampling, request_id=request_id,
-                deadline_s=deadline_s)
+                deadline_s=deadline_s, slo_class=slo_class)
         except BaseException as exc:
             # Refused (shed/dead): untrack and tombstone the admit record.
             with self._lock:
@@ -357,7 +361,8 @@ class EngineSupervisor:
         try:
             tracked.handle = self.service.submit(
                 tracked.prompt_ids + emitted, sampling, request_id=rid,
-                deadline_s=deadline_s, force=True, handle=tracked.handle)
+                deadline_s=deadline_s, force=True, handle=tracked.handle,
+                slo_class=tracked.slo_class)
         except Exception as exc:  # noqa: BLE001 — replay refusal is terminal
             self._finish_tracked(rid, tracked, GenerationResult(
                 request_id=rid, token_ids=emitted, finish_reason="error",
@@ -403,6 +408,7 @@ class EngineSupervisor:
                 deadline_s=rec.deadline_s,
                 arrival_unix=rec.arrival_unix or time.time(),
                 emitted=list(rec.emitted),
+                slo_class=rec.slo_class,
             )
             with self._lock:
                 self._tracked[rec.request_id] = tracked

@@ -310,7 +310,7 @@ class StubAnalysis:
         self.fail = fail
         self.questions: list[tuple[str, str]] = []
 
-    def diagnose(self, question, context=None):
+    def diagnose(self, question, context=None, slo_class="batch"):
         if self.fail:
             raise RuntimeError("engine down")
         self.questions.append((question, context))

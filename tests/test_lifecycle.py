@@ -474,7 +474,7 @@ class _OverloadedAnalysis:
     def __init__(self, exc):
         self._exc = exc
 
-    def query(self, question):
+    def query(self, question, slo_class="interactive"):
         raise self._exc
 
 

@@ -1,0 +1,671 @@
+"""Overload chaos suite: SLO classes, preemptive eviction, brownout ladder.
+
+Exercises the three class-ordered pressure valves (resilience/slo.py +
+docs/resilience.md) end to end on the CPU mesh:
+
+  * class-aware admission — lowest class sheds first, per-class Retry-After
+    streaks, ``interactive`` never refused while ``batch`` waits;
+  * preemptive lane eviction — the lowest-class running lane is
+    recompute-preempted for a higher-class arrival, byte-exactly, with the
+    seeded ``lane_eviction`` fault proving the failure path recovers;
+  * the brownout ladder — hysteretic DEGRADED/DRAINING rungs clamp batch
+    budgets, pause diagnosis triggers, and gate router hedging.
+
+``make chaos-overload`` runs this module under K8SLLM_LOCKCHECK=1; the
+3x-capacity mixed-class burst is the acceptance scenario.
+"""
+
+import math
+import threading
+import time
+
+import pytest
+
+import jax
+
+from k8s_llm_monitor_tpu.models import llama
+from k8s_llm_monitor_tpu.models.config import ModelConfig
+from k8s_llm_monitor_tpu.resilience.faults import get_injector
+from k8s_llm_monitor_tpu.resilience.slo import (
+    BROWNOUT_DEGRADED,
+    BROWNOUT_DRAINING,
+    BROWNOUT_NORMAL,
+    BrownoutController,
+    normalize_slo_class,
+)
+from k8s_llm_monitor_tpu.serving.engine import (
+    EngineConfig,
+    GenerationRequest,
+    InferenceEngine,
+    SamplingParams,
+)
+from k8s_llm_monitor_tpu.serving.service import EngineService, OverloadedError
+
+pytestmark = pytest.mark.chaos
+
+CFG = ModelConfig(name="t", vocab_size=300, hidden_size=32, intermediate_size=64,
+                  num_layers=2, num_heads=4, num_kv_heads=2, dtype="float32",
+                  rope_theta=10_000.0)
+
+# Same shapes as tests/test_resilience.py so the jit cache is shared across
+# modules; prefix cache off so the allocator baseline is exact.
+ECFG = dict(max_slots=4, num_blocks=64, block_size=8,
+            max_blocks_per_seq=16, prefill_buckets=(16,),
+            max_prefills_per_step=4, decode_steps_per_iter=4,
+            prefix_cache_entries=0)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(autouse=True)
+def _fault_isolation():
+    get_injector().reset(seed=1234)
+    yield
+    get_injector().reset()
+
+
+def _mk_engine(params, **overrides):
+    cfg = dict(ECFG)
+    cfg.update(overrides)
+    return InferenceEngine(CFG, params, EngineConfig(**cfg), eos_id=-1)
+
+
+def _run(eng, max_steps=500):
+    steps = 0
+    while eng.has_work:
+        eng.step()
+        steps += 1
+        assert steps < max_steps, "engine wedged: work left after step budget"
+
+
+def _naive_greedy(params, prompt, n):
+    toks = list(prompt)
+    for _ in range(n):
+        logits = llama.forward_full(params, CFG, jax.numpy.asarray([toks]))
+        toks.append(int(jax.numpy.argmax(logits[0, -1])))
+    return toks[len(prompt):]
+
+
+# -- slo.py units ------------------------------------------------------------
+
+
+def test_normalize_slo_class():
+    assert normalize_slo_class("") == "standard"
+    assert normalize_slo_class(None) == "standard"
+    assert normalize_slo_class("", default="interactive") == "interactive"
+    assert normalize_slo_class(" Batch ") == "batch"
+    assert normalize_slo_class("interactive") == "interactive"
+    with pytest.raises(ValueError, match="unknown slo_class"):
+        normalize_slo_class("premium")
+
+
+def test_brownout_ladder_hysteresis():
+    state = {"v": "healthy"}
+    clock = {"t": 0.0}
+    b = BrownoutController(lambda: state["v"], recover_dwell_s=10.0,
+                           clock=lambda: clock["t"])
+    assert b.level() == BROWNOUT_NORMAL
+
+    # Escalation is immediate, and can jump straight to the top rung.
+    state["v"] = "draining"
+    assert b.level() == BROWNOUT_DRAINING
+    assert b.escalations == 1
+
+    # Recovery needs a continuous dwell, one rung at a time.
+    state["v"] = "healthy"
+    assert b.level() == BROWNOUT_DRAINING          # dwell starts now
+    clock["t"] = 9.9
+    assert b.level() == BROWNOUT_DRAINING          # not dwelt long enough
+    clock["t"] = 10.0
+    assert b.level() == BROWNOUT_DEGRADED          # one rung, not straight home
+    assert b.recoveries == 1
+    clock["t"] = 19.9
+    assert b.level() == BROWNOUT_DEGRADED
+    clock["t"] = 20.0
+    assert b.level() == BROWNOUT_NORMAL
+    assert b.recoveries == 2
+
+    # A flap inside the dwell resets the timer (hysteresis).
+    state["v"] = "degraded"
+    assert b.level() == BROWNOUT_DEGRADED
+    state["v"] = "healthy"
+    clock["t"] = 25.0
+    assert b.level() == BROWNOUT_DEGRADED          # dwell starts at t=25
+    state["v"] = "degraded"
+    clock["t"] = 34.0
+    assert b.level() == BROWNOUT_DEGRADED          # flap: timer reset
+    state["v"] = "healthy"
+    clock["t"] = 36.0
+    assert b.level() == BROWNOUT_DEGRADED          # only 2s since the flap
+    clock["t"] = 46.0
+    assert b.level() == BROWNOUT_NORMAL
+
+    snap = b.snapshot()
+    assert snap["name"] == "normal" and snap["escalations"] == 2
+
+
+# -- class-aware admission ---------------------------------------------------
+
+
+def test_shedding_is_class_ordered(params):
+    eng = _mk_engine(params, shed_queue_tokens=24)
+    eng.submit(GenerationRequest("b0", list(range(12)),
+                                 SamplingParams(max_tokens=4),
+                                 slo_class="batch"))
+    eng.submit(GenerationRequest("b1", list(range(12)),
+                                 SamplingParams(max_tokens=4),
+                                 slo_class="batch"))
+    # 24 batch tokens queued: batch is over its own budget...
+    assert "batch" in eng.should_shed("batch")
+    # ...but higher classes are never refused while lower-class work waits
+    # (it would be admitted after them anyway, and it evicts/sheds first).
+    assert eng.should_shed("interactive") == ""
+    assert eng.should_shed("standard") == ""
+
+    # Single-class traffic reduces to the flat threshold.
+    eng2 = _mk_engine(params, shed_queue_tokens=24)
+    eng2.submit(GenerationRequest("s0", list(range(24)),
+                                  SamplingParams(max_tokens=4)))
+    assert eng2.should_shed("standard") != ""
+    # A class is charged for backlog of its own class and above: batch
+    # waits behind the 24 standard tokens, so it sheds too.
+    assert eng2.should_shed("batch") != ""
+    assert eng2.should_shed("interactive") == ""
+    _run(eng)
+    _run(eng2)
+
+
+def test_service_per_class_retry_after_streaks(params):
+    eng = _mk_engine(params)
+    svc = EngineService(eng)
+    try:
+        real_shed = eng.should_shed
+        eng.should_shed = lambda slo_class="standard": "forced overload"
+        hints = {"batch": [], "interactive": []}
+        for _ in range(5):
+            with pytest.raises(OverloadedError) as ei:
+                svc.submit([1, 2, 3], SamplingParams(max_tokens=2),
+                           slo_class="batch")
+            assert ei.value.slo_class == "batch"
+            hints["batch"].append(ei.value.retry_after_s)
+        with pytest.raises(OverloadedError) as ei:
+            svc.submit([1, 2, 3], SamplingParams(max_tokens=2),
+                       slo_class="interactive")
+        hints["interactive"].append(ei.value.retry_after_s)
+
+        # The shed backoff is deterministic (jitter=0, base 1s, cap 8s):
+        # each class escalates its own streak; interactive's first shed is
+        # not inflated by batch's five.
+        assert hints["batch"] == [1.0, 2.0, 4.0, 8.0, 8.0]
+        assert hints["interactive"] == [1.0]
+        assert svc.shed_count_by_class == {"batch": 5, "interactive": 1}
+
+        # A successful admit of the class resets its streak.
+        eng.should_shed = real_shed
+        svc.submit([1, 2, 3], SamplingParams(max_tokens=2),
+                   slo_class="batch").result(timeout=30)
+        eng.should_shed = lambda slo_class="standard": "forced overload"
+        with pytest.raises(OverloadedError) as ei:
+            svc.submit([1, 2, 3], SamplingParams(max_tokens=2),
+                       slo_class="batch")
+        assert ei.value.retry_after_s == 1.0
+        eng.should_shed = real_shed
+    finally:
+        svc.stop(timeout=10.0)
+
+
+# -- preemptive lane eviction ------------------------------------------------
+
+
+def test_voluntary_eviction_is_byte_exact(params):
+    eng = _mk_engine(params, max_slots=2)
+    baseline = eng.allocator.free_blocks
+    eng.submit(GenerationRequest("b0", [5, 6, 7],
+                                 SamplingParams(max_tokens=60),
+                                 slo_class="batch"))
+    eng.submit(GenerationRequest("b1", [8, 9, 10],
+                                 SamplingParams(max_tokens=60),
+                                 slo_class="batch"))
+    eng.step()
+    eng.step()
+    assert eng.active_slots == 2
+    eng.submit(GenerationRequest("i0", [11, 12, 13],
+                                 SamplingParams(max_tokens=6),
+                                 slo_class="interactive"))
+    _run(eng)
+    # Exactly one batch lane paid for the interactive arrival — the
+    # re-sorted queue prevents the victim from reclaiming its own slot
+    # (which would re-evict it every step).
+    assert eng.preemptions_by_class.get("batch", 0) == 1
+    assert eng.preemptions_by_class.get("interactive", 0) == 0
+    # Recompute-preemption is byte-exact: every request matches the
+    # unpreempted greedy decode.
+    for rid, prompt, n in (("b0", [5, 6, 7], 60), ("b1", [8, 9, 10], 60),
+                           ("i0", [11, 12, 13], 6)):
+        res = eng._results[rid]
+        assert res.finish_reason == "length"
+        assert res.token_ids == _naive_greedy(params, prompt, n), rid
+    assert eng.allocator.free_blocks == baseline
+
+
+def test_eviction_never_targets_equal_or_higher_class(params):
+    eng = _mk_engine(params, max_slots=2)
+    eng.submit(GenerationRequest("i0", [5, 6, 7],
+                                 SamplingParams(max_tokens=40),
+                                 slo_class="interactive"))
+    eng.submit(GenerationRequest("s0", [8, 9, 10],
+                                 SamplingParams(max_tokens=40)))
+    eng.step()
+    eng.step()
+    # A standard arrival outranks nobody running: it must wait, not evict.
+    eng.submit(GenerationRequest("s1", [11, 12, 13],
+                                 SamplingParams(max_tokens=4)))
+    _run(eng)
+    assert eng.preemptions == 0
+    assert eng._results["s1"].finish_reason == "length"
+
+
+def test_lane_eviction_fault_recovers(params):
+    eng = _mk_engine(params, max_slots=2)
+    baseline = eng.allocator.free_blocks
+    get_injector().arm("lane_eviction", rate=1.0, times=1)
+    eng.submit(GenerationRequest("b0", [5, 6, 7],
+                                 SamplingParams(max_tokens=60),
+                                 slo_class="batch"))
+    eng.submit(GenerationRequest("b1", [8, 9, 10],
+                                 SamplingParams(max_tokens=60),
+                                 slo_class="batch"))
+    eng.step()
+    eng.step()
+    eng.submit(GenerationRequest("i0", [11, 12, 13],
+                                 SamplingParams(max_tokens=6),
+                                 slo_class="interactive"))
+    _run(eng)
+    # The injected eviction failure left running lanes untouched; the
+    # next step's retry (injector exhausted) completed the preemption.
+    assert get_injector().fired("lane_eviction") == 1
+    assert eng.dispatch_failures >= 1
+    for rid, prompt, n in (("b0", [5, 6, 7], 60), ("b1", [8, 9, 10], 60),
+                           ("i0", [11, 12, 13], 6)):
+        assert eng._results[rid].token_ids == _naive_greedy(params, prompt, n)
+    assert eng.allocator.free_blocks == baseline
+
+
+# -- chunked-prefill fairness (decode cadence under long-prompt backlog) -----
+
+
+def test_decode_progresses_under_chunk_backlog(params):
+    eng = _mk_engine(params, max_slots=2, decode_every_n_chunk_rounds=2)
+    # d0 holds a decode lane for the whole test.
+    eng.submit(GenerationRequest("d0", [5, 6, 7],
+                                 SamplingParams(max_tokens=60),
+                                 slo_class="interactive"))
+    eng.step()
+    eng.step()
+    # Sustained long-prompt backlog: each 48-token prompt needs 3 chunk
+    # rounds through the 16-token bucket.
+    longs = {}
+    for i in range(4):
+        prompt = [(17 * i + j) % 290 + 2 for j in range(48)]
+        longs[f"L{i}"] = prompt
+        eng.submit(GenerationRequest(f"L{i}", prompt,
+                                     SamplingParams(max_tokens=4),
+                                     slo_class="batch"))
+
+    def d0_progress():
+        for s in eng._slots:
+            if s is not None and s.req.request_id == "d0":
+                return len(s.generated)
+        return None
+
+    start = d0_progress()
+    assert start is not None
+    finished_order = []
+    submitted_mid = False
+    steps = 0
+    while eng.has_work and steps < 200:
+        eng.step()
+        steps += 1
+        if steps == 4 and not submitted_mid:
+            # A short interactive prompt arriving mid-backlog must not
+            # queue behind the remaining chunk rounds.
+            eng.submit(GenerationRequest("i1", [20, 21, 22],
+                                         SamplingParams(max_tokens=4),
+                                         slo_class="interactive"))
+            submitted_mid = True
+        for rid in list(longs) + ["i1", "d0"]:
+            if rid in eng._results and rid not in finished_order:
+                finished_order.append(rid)
+        prog = d0_progress()
+        if prog is not None and steps == 8:
+            # Decode interleaved at the configured cadence instead of
+            # starving behind the chunk-round stream.
+            assert prog > start, "decode lane starved by chunk rounds"
+    assert steps < 200
+    # The mid-backlog interactive request finished before the batch tail.
+    assert finished_order.index("i1") < finished_order.index("L3")
+    for rid, prompt in longs.items():
+        assert eng._results[rid].token_ids == _naive_greedy(params, prompt, 4)
+    assert eng._results["i1"].token_ids == _naive_greedy(
+        params, [20, 21, 22], 4)
+    assert eng._results["d0"].token_ids == _naive_greedy(params, [5, 6, 7], 60)
+
+
+# -- brownout effects --------------------------------------------------------
+
+
+def test_brownout_clamps_batch_budget_only(params):
+    eng = _mk_engine(params, brownout_batch_max_tokens=8)
+    eng.brownout = lambda: BROWNOUT_DEGRADED
+    eng.submit(GenerationRequest("b0", [5, 6, 7],
+                                 SamplingParams(max_tokens=40),
+                                 slo_class="batch"))
+    eng.submit(GenerationRequest("i0", [8, 9, 10],
+                                 SamplingParams(max_tokens=12),
+                                 slo_class="interactive"))
+    _run(eng)
+    assert len(eng._results["b0"].token_ids) == 8      # clamped at admission
+    assert len(eng._results["i0"].token_ids) == 12     # untouched
+    assert eng.brownout_clamps == 1
+
+    # At normal, batch keeps its budget.
+    eng2 = _mk_engine(params, brownout_batch_max_tokens=8)
+    eng2.submit(GenerationRequest("b0", [5, 6, 7],
+                                  SamplingParams(max_tokens=12),
+                                  slo_class="batch"))
+    _run(eng2)
+    assert len(eng2._results["b0"].token_ids) == 12
+    assert eng2.brownout_clamps == 0
+
+
+def test_brownout_clamp_exempts_constrained(params):
+    eng = _mk_engine(params, brownout_batch_max_tokens=8)
+    eng.brownout = lambda: BROWNOUT_DEGRADED
+    req = GenerationRequest("c0", [5, 6, 7],
+                            SamplingParams(max_tokens=40, constrained=True),
+                            slo_class="batch")
+    eng._clamp_for_brownout(req)
+    # The grammar's forced-EOS path needs its full budget reachable.
+    assert req.sampling.max_tokens == 40
+    assert eng.brownout_clamps == 0
+
+
+def test_pipeline_triggers_pause_at_draining():
+    from k8s_llm_monitor_tpu.diagnosis.pipeline import DiagnosisPipeline
+    from k8s_llm_monitor_tpu.monitor.config import DiagnosisConfig
+    from k8s_llm_monitor_tpu.monitor.models import EventInfo
+
+    level = {"v": BROWNOUT_DRAINING}
+    clock = {"t": 0.0}
+
+    def tick():
+        clock["t"] += 1.0
+        return clock["t"]
+
+    pipe = DiagnosisPipeline(
+        analysis=None,
+        cfg=DiagnosisConfig(burst_threshold=2, window_s=60.0, cooldown_s=0.0),
+        brownout=lambda: level["v"],
+        clock=tick)
+    for _ in range(4):
+        pipe.offer(EventInfo(type="Warning", reason="OOMKilled"))
+    # Bursts were detected but every trigger was paused: the engine is
+    # shedding real traffic, background diagnosis must not compete.
+    assert pipe.triggers_total == 0
+    assert pipe.paused_total >= 1
+
+    level["v"] = BROWNOUT_DEGRADED  # degraded still diagnoses
+    for _ in range(2):
+        pipe.offer(EventInfo(type="Warning", reason="OOMKilled"))
+    assert pipe.triggers_total == 1
+
+
+# -- client retry hints (satellite: 429 decorrelated jitter) -----------------
+
+
+def test_client_retry_hint_decorrelated_jitter():
+    from k8s_llm_monitor_tpu.monitor.client import ApiClient
+
+    cl = ApiClient("http://127.0.0.1:9")
+    hints = [cl._retry_hint_s(2.0, "batch") for _ in range(8)]
+    # Every delay honors the server hint as a floor and the cap as a
+    # ceiling; consecutive 429s spread over a widening window instead of
+    # all clients sleeping exactly the hinted value.
+    assert all(2.0 <= h <= cl.retry_cap_s for h in hints)
+    assert len(set(hints)) > 1
+    for prev, cur in zip(hints, hints[1:]):
+        assert cur <= max(2.0, prev * 3.0) + 1e-9
+
+    # Per-class streaks are independent: a fresh class starts at its hint.
+    first_interactive = cl._retry_hint_s(0.5, "interactive")
+    assert 0.5 <= first_interactive <= 1.5
+    # A successful POST clears the map (simulated here).
+    cl._retry_prev_s.clear()
+    assert 2.0 <= cl._retry_hint_s(2.0, "batch") <= 6.0
+
+
+def test_client_maps_429_payload_to_overloaded():
+    from k8s_llm_monitor_tpu.monitor.client import ApiClient
+
+    class _Fake429:
+        code = 429
+
+        def read(self):
+            return (b'{"error_kind": "overloaded", "reason": "queue full",'
+                    b' "retry_after_s": 4.0, "slo_class": "batch",'
+                    b' "queue_depth": 7, "queue_tokens": 120}')
+
+    cl = ApiClient("http://127.0.0.1:9")
+    err = cl._overloaded_from(_Fake429())
+    assert isinstance(err, OverloadedError)
+    assert err.slo_class == "batch"
+    assert err.retriable
+    assert err.queue_depth == 7 and err.queue_tokens == 120
+    # The hint passed through the jitter schedule, not a flat fallback.
+    assert 4.0 <= err.retry_after_s <= cl.retry_cap_s
+
+    class _Fake500(_Fake429):
+        code = 500
+
+    assert cl._overloaded_from(_Fake500()) is None
+
+
+# -- exporter per-class series (satellite: /metrics) -------------------------
+
+
+def test_exporter_emits_per_class_series(params):
+    from k8s_llm_monitor_tpu.monitor.exporter import (_resilience_metrics,
+                                                      _Writer)
+
+    class _StubHealth:
+        sheds = 3
+
+        def state(self):
+            return "healthy"
+
+    class _StubService:
+        health = _StubHealth()
+        shed_count_by_class = {"batch": 2}
+        brownout = BrownoutController(lambda: "healthy")
+
+    eng = _mk_engine(params)
+    eng.preemptions_by_class["batch"] = 4
+    eng.ttft_ema_by_class["interactive"] = 0.25
+    w = _Writer()
+    _resilience_metrics(w, eng, _StubService())
+    text = w.render()
+    assert 'k8s_llm_monitor_shed_total{class="batch"} 2' in text
+    assert 'k8s_llm_monitor_shed_total{class="interactive"} 0' in text
+    assert 'k8s_llm_monitor_preemptions_total{class="batch"} 4' in text
+    assert 'k8s_llm_monitor_brownout_state{state="normal"} 1' in text
+    assert 'k8s_llm_monitor_brownout_state{state="draining"} 0' in text
+    # Unmeasured classes emit an explicit NaN marker, not 0.0 — the router
+    # proxies replica /metrics, and a fake zero would pollute the
+    # population; measured classes emit the EMA.
+    assert ('k8s_llm_monitor_engine_ttft_ema_seconds{class="interactive"} '
+            '0.25' in text)
+    assert ('k8s_llm_monitor_engine_ttft_ema_seconds{class="batch"} NaN'
+            in text)
+    assert 'k8s_llm_monitor_queue_wait_ms{class="interactive"} NaN' in text
+    assert math.isnan(float("NaN"))  # the marker parses as a float
+
+
+# -- fleet: class routing + stats plumbing -----------------------------------
+
+
+def _stat_replica(rid, **stats):
+    from k8s_llm_monitor_tpu.fleet.registry import ReplicaStats
+    from k8s_llm_monitor_tpu.fleet.replica import Replica
+
+    class _R(Replica):
+        supports_tokens = True
+        supports_query = True
+
+        def __init__(self):
+            self.replica_id = rid
+
+        def readyz(self):
+            return True
+
+        def stats(self):
+            return ReplicaStats(**stats)
+
+    return _R()
+
+
+def _mk_router(*reps, **kw):
+    from k8s_llm_monitor_tpu.fleet.registry import ReplicaRegistry
+    from k8s_llm_monitor_tpu.fleet.router import FleetRouter
+
+    reg = ReplicaRegistry()
+    for r in reps:
+        reg.add(r)
+    reg.refresh()
+    return FleetRouter(reg, **kw)
+
+
+def test_interactive_routes_least_loaded_over_policy():
+    # Round-robin would alternate heads; interactive always takes the
+    # least-loaded replica so an operator query never queues behind a
+    # backlog the rotation happens to point at.
+    router = _mk_router(
+        _stat_replica("a", queue_tokens=100, total_slots=4),
+        _stat_replica("b", total_slots=4),
+        policy="round_robin")
+    for _ in range(4):
+        ranked = router._ranked(b"x", need_tokens=True,
+                                slo_class="interactive")
+        assert ranked[0].replica_id == "b"
+    # Standard traffic still follows the configured policy's rotation.
+    heads = {router._ranked(b"x", True, "standard")[0].replica_id
+             for _ in range(4)}
+    assert heads == {"a", "b"}
+
+
+def test_batch_spills_only_below_saturation():
+    router = _mk_router(
+        _stat_replica("a", total_slots=4),
+        _stat_replica("b", busy_slots=3, total_slots=4),
+        policy="least_loaded", batch_spill_threshold=0.75)
+    ranked = router._ranked(b"x", need_tokens=True, slo_class="batch")
+    # b sits exactly at the 0.75 saturation threshold: kept as the
+    # affinity/policy head only, dropped as a spill target.
+    assert [c.replica_id for c in ranked] == ["a"]
+    ranked = router._ranked(b"x", need_tokens=True, slo_class="standard")
+    assert [c.replica_id for c in ranked] == ["a", "b"]
+
+
+def test_browned_out_replica_suppresses_hedge_and_stats_parse():
+    from k8s_llm_monitor_tpu.fleet.registry import ReplicaStats
+
+    router = _mk_router(
+        _stat_replica("a", total_slots=4, brownout=1),
+        _stat_replica("b", total_slots=4))
+    assert router._replica_browned_out("a")
+    assert not router._replica_browned_out("b")
+    assert not router._replica_browned_out("missing")
+
+    st = ReplicaStats.from_payload({"engine": {
+        "queue_depth": 2, "queue_tokens": 30, "busy_slots": 1,
+        "total_slots": 4, "brownout": 2,
+        "queue_tokens_by_class": {"batch": 24, "interactive": 6},
+    }})
+    assert st.brownout == 2
+    assert st.queue_by_class == {"batch": 24, "interactive": 6}
+    # Pre-SLO replicas simply report empty class maps.
+    old = ReplicaStats.from_payload({"engine": {"queue_depth": 1}})
+    assert old.brownout == 0 and old.queue_by_class == {}
+
+
+# -- acceptance: 3x-capacity mixed-class burst -------------------------------
+
+
+def test_chaos_mixed_class_burst_protects_interactive(params):
+    """The `make chaos-overload` acceptance scenario: a sustained burst at
+    3x slot capacity across all three classes, with seeded eviction faults,
+    must shed only the lower classes (interactive sheds stay zero), keep
+    every accepted request byte-exact, and return the allocator to its
+    idle baseline."""
+    eng = _mk_engine(params, shed_queue_tokens=48, max_preemptions=2)
+    baseline = eng.allocator.free_blocks
+    svc = EngineService(eng)
+    get_injector().arm("lane_eviction", rate=0.25, times=2)
+
+    reqs = []  # (rid, prompt, max_tokens, slo_class)
+    for i in range(4):
+        reqs.append((f"i{i}", [(7 * i + j) % 290 + 2 for j in range(4)],
+                     6, "interactive"))
+        reqs.append((f"s{i}", [(11 * i + j) % 290 + 2 for j in range(8)],
+                     10, "standard"))
+        reqs.append((f"b{i}", [(13 * i + j) % 290 + 2 for j in range(12)],
+                     16, "batch"))
+
+    from k8s_llm_monitor_tpu.devtools.lockcheck import make_lock
+
+    handles = {}
+    lock = make_lock("test.overload_burst")
+    errors = []
+
+    def submit_class(cls):
+        for rid, prompt, mt, c in reqs:
+            if c != cls:
+                continue
+            deadline = time.monotonic() + 60.0
+            while True:
+                try:
+                    h = svc.submit(prompt, SamplingParams(max_tokens=mt),
+                                   request_id=rid, slo_class=c)
+                    with lock:
+                        handles[rid] = h
+                    break
+                except OverloadedError as exc:
+                    if time.monotonic() > deadline:
+                        with lock:
+                            errors.append(f"{rid}: still shed ({exc})")
+                        return
+                    time.sleep(min(exc.retry_after_s, 0.05))
+
+    threads = [threading.Thread(target=submit_class, args=(c,))
+               for c in ("interactive", "standard", "batch")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=90.0)
+    assert errors == []
+    assert len(handles) == len(reqs)
+
+    results = {rid: h.result(timeout=60.0) for rid, h in handles.items()}
+    for rid, prompt, mt, _ in reqs:
+        res = results[rid]
+        assert res.finish_reason == "length", (rid, res.error)
+        assert res.token_ids == _naive_greedy(params, prompt, mt), rid
+
+    # The interactive-only backlog (16 tokens) can never reach the shed
+    # threshold, and the class discount shields it from everyone else's:
+    # zero interactive sheds however hard standard/batch pushed.
+    assert svc.shed_count_by_class.get("interactive", 0) == 0
+    svc.stop(timeout=10.0)
+    assert eng.allocator.free_blocks == baseline
