@@ -6,8 +6,8 @@ TEST_ENV = env PYTHONPATH= JAX_PLATFORMS=cpu
 SHELL := /bin/bash    # tier1 uses pipefail/PIPESTATUS
 
 .PHONY: run run-agent run-scheduler demo test test-fast tier1 tier1-mesh \
-        chaos chaos-lifecycle chaos-fleet chaos-overload diagnose-e2e \
-        bench bench-decode \
+        chaos chaos-lifecycle chaos-fleet chaos-overload chaos-kvtier \
+        diagnose-e2e bench bench-decode \
         bench-fleet bench-mesh dryrun smoke preflight deploy-agent docker \
         docker-agent docker-scheduler lint lint-trace clean
 
@@ -74,6 +74,15 @@ chaos-fleet:
 chaos-overload:
 	$(TEST_ENV) K8SLLM_LOCKCHECK=1 \
 	  $(PY) -m pytest tests/test_overload.py -q -p no:cacheprovider
+
+# KV-tier acceptance (docs/serving.md "KV tiers & prefix migration"):
+# quantized-KV greedy parity, host-RAM spill/restore byte-exactness,
+# supervisor-rebuild rehydration (+ replay fallback with the spill buffer
+# gone), and cross-replica migration with a mid-migration replica kill —
+# with lock discipline checked.
+chaos-kvtier:
+	$(TEST_ENV) K8SLLM_LOCKCHECK=1 \
+	  $(PY) -m pytest tests/test_kv_tier.py -q -p no:cacheprovider
 
 # Diagnosis acceptance (docs/diagnosis.md): grammar compiler units, the
 # constrained-sampling fuzz (every sample parses), and the synthetic

@@ -190,6 +190,204 @@ def fleet_leg(cfg, params) -> dict:
     }
 
 
+def kv_tier_leg(cfg, params) -> dict:
+    """KV-tier rung 1 (serving/kv_tier.py): int8 resident KV must hold
+    >= 1.8x the decode lanes of the model-dtype pool on the SAME pool
+    bytes.  The byte math is exact (kv_cache.py:page_slice_bytes, scales
+    included); the engine pair proves it end-to-end: two engines whose
+    ``num_blocks`` are sized from one shared byte budget drain the same
+    burst, and the peak concurrently-resident lane counts are compared.
+    A greedy parity sample on identical prompts rides along (the
+    tolerance-gated divergence budget lives in tests/test_kv_tier.py);
+    a spill/restore pass exercises rung 2 and reports its counters."""
+    import numpy as np
+
+    from k8s_llm_monitor_tpu.serving.engine import (
+        EngineConfig,
+        GenerationRequest,
+        InferenceEngine,
+        SamplingParams,
+    )
+    from k8s_llm_monitor_tpu.serving.kv_cache import page_slice_bytes
+
+    bs = 16
+    model_itemsize = np.dtype(cfg.kv_dtype or cfg.dtype).itemsize
+    page_model = page_slice_bytes(cfg.num_kv_heads, cfg.head_dim_, bs,
+                                  model_itemsize, scale_bytes=0)
+    page_int8 = page_slice_bytes(cfg.num_kv_heads, cfg.head_dim_, bs, 1,
+                                 scale_bytes=4)
+    byte_ratio = page_model / page_int8
+
+    k_len, k_gen = 64, 40
+    cap = k_len + k_gen + 1
+    bps = (cap + bs - 1) // bs
+    blocks_model = 4 * bps + 2              # 4 resident lanes + slack
+    budget = blocks_model * page_model      # per (layer, k/v) slice
+    blocks_int8 = budget // page_int8
+    rng = np.random.default_rng(13)
+    prompts = [[int(t) for t in rng.integers(4, cfg.vocab_size - 4,
+                                             size=k_len)]
+               for _ in range(16)]
+
+    def run(kv_dtype: str, num_blocks: int):
+        ecfg = EngineConfig(
+            max_slots=16, num_blocks=int(num_blocks), block_size=bs,
+            max_blocks_per_seq=bps, prefill_buckets=(k_len,),
+            max_prefills_per_step=4, decode_steps_per_iter=4,
+            prefix_cache_entries=0, kv_dtype=kv_dtype)
+        eng = InferenceEngine(cfg, params, ecfg, eos_id=-1)
+        eng.generate([prompts[0]], SamplingParams(max_tokens=4))  # warm
+        for i, p in enumerate(prompts):
+            eng.submit(GenerationRequest(
+                request_id=f"kv-{i}", prompt_ids=p,
+                sampling=SamplingParams(max_tokens=k_gen)))
+        peak = 0
+        while eng.has_work:
+            eng.step()
+            peak = max(peak, eng.active_slots)
+        res = [eng.poll(f"kv-{i}") for i in range(len(prompts))]
+        assert all(r is not None and r.finish_reason != "error"
+                   for r in res)
+        streams = [r.token_ids for r in res]
+        del eng
+        return peak, streams
+
+    lanes_model, ref_streams = run("auto", blocks_model)
+    lanes_int8, q_streams = run("int8", blocks_int8)
+    lanes_ratio = lanes_int8 / max(lanes_model, 1)
+    # Greedy agreement prefix across the identical-prompt streams: int8
+    # dequant error can flip near-tied argmaxes, so this is a sample, not
+    # a gate (the gated budget is test_kv_tier.py's parity test).
+    agree = []
+    for a, b in zip(ref_streams, q_streams):
+        m = 0
+        while m < min(len(a), len(b)) and a[m] == b[m]:
+            m += 1
+        agree.append(m / max(len(a), 1))
+    parity = float(np.median(agree))
+    log(f"kv tier: int8 page {page_int8} B vs {cfg.kv_dtype or cfg.dtype} "
+        f"{page_model} B ({byte_ratio:.2f}x byte ratio); peak resident "
+        f"lanes {lanes_int8} vs {lanes_model} ({lanes_ratio:.2f}x) on "
+        f"{budget * 2 * cfg.num_layers / 2**20:.1f} MiB pool; greedy "
+        f"parity prefix {parity:.2f}")
+
+    # Rung 2 spill/restore: a pool that holds ~2 cached prefixes cycles
+    # through 4, so pressured evictions spill to the host tier and the
+    # second pass restores instead of re-prefilling.
+    spills = restores = -1
+    try:
+        # Pool sized well under 6 resident prefixes: cycling 6 distinct
+        # prefixes forces pressured evictions (spills); the second pass
+        # rehydrates the spilled ones instead of re-prefilling.
+        s_ecfg = EngineConfig(
+            max_slots=4, num_blocks=2 * bps + 2, block_size=bs,
+            max_blocks_per_seq=bps, prefill_buckets=(k_len,),
+            max_prefills_per_step=2, decode_steps_per_iter=4,
+            kv_dtype="int8", host_spill_bytes=256 << 20)
+        seng = InferenceEngine(cfg, params, s_ecfg, eos_id=-1)
+        for _round in range(2):
+            for p in prompts[:6]:
+                seng.generate([p], SamplingParams(max_tokens=4))
+        st = seng.kv_tier_stats()
+        spills, restores = st["spills"], st["restores"]
+        log(f"kv tier spill/restore: {spills} spills, {restores} restores,"
+            f" host {st['host_bytes'] / 2**20:.1f} MiB "
+            f"({st['host_entries']} entries)")
+        del seng
+    except Exception as exc:  # noqa: BLE001 — extras never fail the bench
+        log(f"kv tier spill pass skipped: {exc}")
+    return {
+        "kv_tier_page_bytes_model": page_model,
+        "kv_tier_page_bytes_int8": page_int8,
+        "kv_tier_byte_ratio": round(byte_ratio, 3),
+        "kv_tier_resident_lanes_model": lanes_model,
+        "kv_tier_resident_lanes_int8": lanes_int8,
+        "kv_tier_lanes_ratio": round(lanes_ratio, 3),
+        "kv_tier_parity_prefix": round(parity, 3),
+        "kv_spills": spills,
+        "kv_restores": restores,
+    }
+
+
+def migration_leg(cfg, params) -> dict:
+    """KV-tier rung 3 (fleet/router.py): on a prefix-affinity miss the
+    router moves the owning replica's shared KV pages to the target
+    instead of re-prefilling.  This leg measures the miss TTFT both ways
+    on identical prompts — cold re-prefill on one replica vs
+    fetch+install+decode on another — with every compiled shape warmed
+    first, so the ratio is pure scheduling + page movement."""
+    import numpy as np
+
+    from k8s_llm_monitor_tpu.fleet import LocalReplica
+    from k8s_llm_monitor_tpu.serving.engine import (
+        EngineConfig,
+        InferenceEngine,
+        SamplingParams,
+    )
+    from k8s_llm_monitor_tpu.serving.service import EngineService
+
+    m_len = int(os.environ.get("BENCH_MIG_PROMPT_LEN", "769"))
+    cap = m_len + 24
+    ecfg = EngineConfig(
+        max_slots=4, num_blocks=4 * ((cap + 15) // 16) + 16, block_size=16,
+        max_blocks_per_seq=(cap + 15) // 16, prefill_buckets=(64,),
+        max_prefills_per_step=2, decode_steps_per_iter=4)
+
+    def rep(name: str) -> LocalReplica:
+        return LocalReplica(name, service=EngineService(
+            InferenceEngine(cfg, params, ecfg, eos_id=-1)))
+
+    rng = np.random.default_rng(17)
+
+    def mk_prompt() -> list[int]:
+        return [int(t) for t in
+                rng.integers(4, cfg.vocab_size - 4, size=m_len)]
+
+    warm, warm2, p = mk_prompt(), mk_prompt(), mk_prompt()
+    owner, cold, target = rep("mig-owner"), rep("mig-cold"), rep("mig-tgt")
+    try:
+        sp = SamplingParams(max_tokens=4)
+        for r in (owner, cold, target):
+            # Two passes: the first compiles the chunk-round programs, the
+            # second (a prefix hit) compiles the suffix-sized hit path.
+            r.generate(warm, sp).result(timeout=600.0)
+            r.generate(warm, sp).result(timeout=600.0)
+        # Warm the move path itself: export on the owner and install on the
+        # target each compile a one-time gather/scatter program (~100+ ms)
+        # that must not be billed to the measured migration.  The warmup
+        # blob is a prefix the target has NOT seen — installing an
+        # already-cached prefix short-circuits before the scatter.
+        owner.generate(warm2, sp).result(timeout=600.0)
+        wblob = owner.fetch_prefix(warm2)
+        assert wblob is not None and target.install_prefix(wblob) \
+            == "installed"
+        owner.generate(p, sp).result(timeout=600.0)   # owner caches p
+        reprefill_s = cold.generate(p, sp).result(timeout=600.0).ttft_s
+        t0 = time.monotonic()
+        blob = owner.fetch_prefix(p)
+        assert blob is not None, "owner lost the prefix"
+        outcome = target.install_prefix(blob)
+        assert outcome == "installed", outcome
+        move_s = time.monotonic() - t0
+        migration_s = move_s + target.generate(p, sp).result(
+            timeout=600.0).ttft_s
+    finally:
+        for r in (owner, cold, target):
+            r.close()
+    ratio = migration_s / max(reprefill_s, 1e-9)
+    log(f"prefix migration ({m_len}-token prompt, {len(blob)} B blob): "
+        f"miss TTFT {migration_s * 1e3:.1f} ms migrated "
+        f"(fetch+install {move_s * 1e3:.1f} ms) vs {reprefill_s * 1e3:.1f} "
+        f"ms re-prefilled ({ratio:.2f}x; budget <= 0.5x)")
+    return {
+        "migration_ttft_ms": round(migration_s * 1e3, 2),
+        "migration_reprefill_ttft_ms": round(reprefill_s * 1e3, 2),
+        "migration_ttft_ratio": round(ratio, 3),
+        "migration_blob_bytes": len(blob),
+        "migration_prompt_len": m_len,
+    }
+
+
 def mesh_leg(cfg, params) -> dict:
     """ICI-sharded serving leg: ONE tensor-parallel engine over every local
     device (weights column/row-sharded, KV pages head-sharded — parallel/
@@ -1051,7 +1249,6 @@ def main() -> None:
     # property that makes shipping the feature safe.  spec_k defaults to
     # 0 in the serving config; enable it for real quoting checkpoints.
     spec_tok_s = spec_base_tok_s = spec_tpv = None
-    spec_quote_tpv = None
     try:
         import dataclasses as _dc
 
@@ -1114,28 +1311,102 @@ def main() -> None:
                 spec_base_tok_s = tput
             del se
 
-        # Record the most favorable honest quoting construction in the
-        # artifact: prompts embedding the model's own prior greedy
-        # continuation (P + G + P + G[:16], so the true continuation of a
-        # quoting model WOULD be G[16:], and the n-gram proposer drafts
-        # exactly that).  spec_min_accept=0 disables the adaptive
-        # fallback so the number is true acceptance, not the probe EMA.
-        qe = InferenceEngine(
-            cfg, params,
-            _dc.replace(sp_base, spec_k=4, spec_min_accept=0.0),
-            eos_id=-1)
-        qps = [prompt()[:64] for _ in range(8)]
-        qouts = qe.generate(qps, SamplingParams(max_tokens=48))
-        qe.spec_tokens = qe.spec_verify_steps = qe.spec_lane_rounds = 0
-        qe.generate([p + r.token_ids + p + r.token_ids[:16]
-                     for p, r in zip(qps, qouts)],
-                    SamplingParams(max_tokens=48))
-        spec_quote_tpv = qe.spec_tokens / max(qe.spec_lane_rounds, 1)
-        log(f"spec self-quote construction: {spec_quote_tpv:.2f} accepted "
-            f"tokens/lane-round (1.0 = floor; random weights don't quote)")
-        del qe
     except Exception as exc:  # noqa: BLE001 — extras never fail the bench
         log(f"spec-decode leg skipped: {exc}")
+
+    # --- spec quote mode: acceptance measured on a model that QUOTES ----
+    # Every prompt construction against random-init weights measures the
+    # 1.0 floor (tried: random prompts, prompts embedding the model's own
+    # prior greedy continuation P+G+P+G[:16], fully periodic prompts, and
+    # fixed-point iteration Q <- greedy(P+Q) — the greedy map is chaotic
+    # and never converges), so the old self-quote construction was
+    # structurally flat: it could only ever print 1.0.  This leg instead
+    # builds a checkpoint that genuinely quotes: attention and MLP output
+    # projections zeroed (the residual stream carries exactly the current
+    # token's embedding) and the unembed wired to a vocab-cycle
+    # permutation of the embedding table, so greedy decode
+    # deterministically walks the cycle.  A prompt holding two periods of
+    # that cycle IS a quoting workload — the true continuation re-walks
+    # trigrams the history already contains, the regime the n-gram
+    # proposer (serving/spec.py) exists for.  Same engine, same verify
+    # kernels, real forward passes; only the checkpoint is synthetic.
+    spec_quote_accept = None
+    spec_quote_tok_s = spec_quote_base_tok_s = None
+    try:
+        import copy as _copy
+
+        import jax.numpy as jnp
+        from k8s_llm_monitor_tpu.models.config import ModelConfig as _MC
+
+        qcfg = _MC(name="quote-tiny", vocab_size=512, hidden_size=64,
+                   intermediate_size=128, num_layers=2, num_heads=4,
+                   num_kv_heads=2, dtype="float32", rope_theta=10_000.0)
+        qparams = _copy.deepcopy(llama.init_params(jax.random.PRNGKey(11),
+                                                   qcfg))
+        cyc0, cycn = 10, 48
+        orbit = list(range(cyc0, cyc0 + cycn))
+        qE = np.asarray(qparams["embed"]["weight"], np.float32)
+        qU = np.zeros((qcfg.hidden_size, qcfg.vocab_size), np.float32)
+        for qi, qt in enumerate(orbit):
+            qU[:, orbit[(qi + 1) % cycn]] = qE[qt]
+        for qlayer in qparams["layers"]:
+            qlayer["o"]["kernel"] = jnp.zeros_like(qlayer["o"]["kernel"])
+            qlayer["down"]["kernel"] = jnp.zeros_like(
+                qlayer["down"]["kernel"])
+        qparams["lm_head"]["kernel"] = jnp.asarray(qU)
+
+        q_gen, q_n = 96, 8
+        # Distinct per-lane prompts (cycle rotations — each still quotes):
+        # identical prompts would trip cold-burst dedup and prefix reuse.
+        q_prompts = [orbit[qi:] + orbit[:qi] + orbit[qi:] + orbit[:qi]
+                     for qi in range(q_n)]
+        q_cap = 2 * cycn + q_gen + 1
+        q_ecfg = EngineConfig(
+            max_slots=q_n, num_blocks=q_n * ((q_cap + 15) // 16) + 8,
+            block_size=16, max_blocks_per_seq=(q_cap + 15) // 16,
+            prefill_buckets=(2 * cycn,), max_prefills_per_step=q_n,
+            decode_steps_per_iter=8, prefix_cache_entries=0)
+        import dataclasses as _dc
+
+        for q_k in (0, 4):
+            qe = InferenceEngine(
+                qcfg, qparams,
+                _dc.replace(q_ecfg, spec_k=q_k, spec_min_accept=0.0),
+                eos_id=-1)
+            qe.generate(q_prompts, SamplingParams(max_tokens=8))  # warm
+            qe.spec_tokens = qe.spec_verify_steps = qe.spec_lane_rounds = 0
+            qt0 = time.monotonic()
+            for qi, qp in enumerate(q_prompts):
+                qe.submit(GenerationRequest(
+                    request_id=f"q-{qi}", prompt_ids=qp,
+                    sampling=SamplingParams(max_tokens=q_gen)))
+            while qe.has_work:
+                qe.step()
+            q_dt = time.monotonic() - qt0
+            q_res = [qe.poll(f"q-{qi}") for qi in range(q_n)]
+            assert all(r is not None and r.finish_reason != "error"
+                       for r in q_res)
+            # Self-consistency gate: every lane must have emitted its own
+            # cycle continuation exactly, or the acceptance number is
+            # measuring a broken construction rather than quoting.
+            for qi, r in enumerate(q_res):
+                want = [orbit[(qi + j) % cycn] for j in range(q_gen)]
+                assert r.token_ids == want, f"lane {qi} left the cycle"
+            tput = q_n * q_gen / q_dt
+            if q_k:
+                spec_quote_tok_s = tput
+                spec_quote_accept = (qe.spec_tokens /
+                                     max(qe.spec_lane_rounds, 1))
+            else:
+                spec_quote_base_tok_s = tput
+            del qe
+        log(f"spec quote mode (cycle checkpoint): {spec_quote_accept:.2f} "
+            f"accepted tokens/lane-round (ceiling {4 + 1}.0), "
+            f"{spec_quote_tok_s:.0f} tok/s vs {spec_quote_base_tok_s:.0f} "
+            f"unspeculated "
+            f"({spec_quote_tok_s / spec_quote_base_tok_s:.2f}x)")
+    except Exception as exc:  # noqa: BLE001 — extras never fail the bench
+        log(f"spec quote-mode leg skipped: {exc}")
 
     # --- long-context verify: the Pallas multi-query kernel on a measured
     # path.  At >= 2048-token tables (the VERIFY_KERNEL_MIN_TABLE_TOKENS
@@ -1461,6 +1732,20 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001 — extras never fail the bench
         log(f"fleet leg skipped: {exc}")
 
+    kv_tier_stats_d: dict = {}
+    try:
+        if os.environ.get("BENCH_KVTIER", "1") == "1":
+            kv_tier_stats_d = kv_tier_leg(cfg, params)
+    except Exception as exc:  # noqa: BLE001 — extras never fail the bench
+        log(f"kv tier leg skipped: {exc}")
+
+    migration_stats: dict = {}
+    try:
+        if os.environ.get("BENCH_MIGRATION", "1") == "1":
+            migration_stats = migration_leg(cfg, params)
+    except Exception as exc:  # noqa: BLE001 — extras never fail the bench
+        log(f"prefix migration leg skipped: {exc}")
+
     extras = {
         "model": model_name,
         "quant": quant,
@@ -1565,8 +1850,12 @@ def main() -> None:
         extras["spec_default"] = "off (spec_k=0): random-init weights "\
             "measure the 1.0 acceptance floor on every construction; "\
             "this leg proves the adaptive floor costs ~nothing"
-    if spec_quote_tpv is not None:
-        extras["spec_selfquote_accept"] = round(spec_quote_tpv, 2)
+    if spec_quote_accept is not None:
+        extras["spec_quote_accept"] = round(spec_quote_accept, 2)
+        extras["spec_quote_tok_s"] = round(spec_quote_tok_s, 1)
+        extras["spec_quote_base_tok_s"] = round(spec_quote_base_tok_s, 1)
+        extras["spec_quote_speedup"] = round(
+            spec_quote_tok_s / max(spec_quote_base_tok_s, 1e-9), 2)
     if vk_tok_s is not None and vg_tok_s is not None:
         extras["verify_kernel_longctx_tok_s"] = round(vk_tok_s, 1)
         extras["verify_gather_longctx_tok_s"] = round(vg_tok_s, 1)
@@ -1578,6 +1867,8 @@ def main() -> None:
         extras["warm_restart_to_token_ms"] = round(restart_to_token_ms, 1)
         extras["warm_restart_replayed"] = restart_replayed
     extras.update(fleet_stats)
+    extras.update(kv_tier_stats_d)
+    extras.update(migration_stats)
     log(f"total bench time {time.monotonic() - t0:.0f}s")
     print(json.dumps({
         "metric": "p50_ttft_100c_ms",

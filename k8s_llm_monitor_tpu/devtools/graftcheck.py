@@ -41,9 +41,9 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--trace", action="store_true",
                         help="also run the trace-time guards (jit-compiles "
                              "a tiny engine on CPU; slower)")
-    parser.add_argument("--trace-paths", default="gather,fused,mesh",
+    parser.add_argument("--trace-paths", default="gather,fused,mesh,quant",
                         help="comma-separated decode paths for --trace "
-                             "(default: gather,fused,mesh)")
+                             "(default: gather,fused,mesh,quant)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the AST rules and exit")
     args = parser.parse_args(argv)
@@ -94,7 +94,7 @@ def main(argv: list[str] | None = None) -> int:
                       f"forbidden ops="
                       f"{sum(map(len, rep['forbidden'].values()))}, "
                       f"donation rebound="
-                      f"{rep['donated_pages_rebound'] and rep['donated_tokens_rebound']})")
+                      f"{rep['donated_pages_rebound'] and rep['donated_tokens_rebound'] and rep['donated_scales_rebound']})")
     return 0 if ok else 1
 
 

@@ -43,8 +43,11 @@ FORBIDDEN_PRIMITIVES = frozenset({
 #: builds the engine under a GSPMD mesh spanning every local device (the
 #: forced-host 8-device CPU mesh in CI) so the SHARDED fused-decode and
 #: chunk-prefill programs are gated too — same zero-recompile and
-#: donation-rebinding assertions, now over collective-aware programs.
-DEFAULT_PATHS = ("gather", "fused", "mesh")
+#: donation-rebinding assertions, now over collective-aware programs;
+#: "quant" builds the engine with kv_dtype="int8" so the quantize-on-append
+#: prefill/decode programs and the widened donation set (page pool PLUS the
+#: per-page scale leaves) are held to the same zero-recompile gate.
+DEFAULT_PATHS = ("gather", "fused", "mesh", "quant")
 
 
 def force_cpu() -> None:
@@ -118,7 +121,12 @@ def build_engine(decode_path: str = "gather", seed: int = 0):
     every local device (TP on ``model``), weights and KV pages device-put
     with the SpecLayout-derived NamedShardings, attention on the XLA
     gather oracle (GSPMD partitions it from the annotations) — the same
-    programs the v5e-8 serving config runs, minus real ICI."""
+    programs the v5e-8 serving config runs, minus real ICI.
+
+    ``decode_path="quant"`` builds the int8-KV engine (kv_dtype="int8"):
+    the engine's own impl selection routes decode through the gather/
+    dequant reference off-TPU, and the donation set gains the per-page
+    scale leaves — the guard asserts those rebind too."""
     import jax
 
     from k8s_llm_monitor_tpu.models import llama
@@ -126,6 +134,7 @@ def build_engine(decode_path: str = "gather", seed: int = 0):
     from k8s_llm_monitor_tpu.serving.engine import EngineConfig, InferenceEngine
 
     mesh = None
+    kv_dtype = "auto"
     if decode_path == "mesh":
         from k8s_llm_monitor_tpu.parallel.mesh import MeshConfig, create_mesh
 
@@ -133,6 +142,13 @@ def build_engine(decode_path: str = "gather", seed: int = 0):
         mesh = create_mesh(MeshConfig(model=tp))
         cfg = _tiny_cfg(fused=False, mesh_tp=tp)
         impl = select_decode_impl(cfg=cfg, mesh=mesh, mode="gather")
+    elif decode_path == "quant":
+        # attn_impl=None: the engine's select_decode_impl call sees the
+        # quantized pool and picks the dequantizing path itself — the same
+        # branch a production int8 config takes.
+        cfg = _tiny_cfg(fused=False)
+        impl = None
+        kv_dtype = "int8"
     else:
         cfg = _tiny_cfg(fused=decode_path == "fused")
         impl = select_decode_impl(cfg=cfg, mode=decode_path)
@@ -142,6 +158,7 @@ def build_engine(decode_path: str = "gather", seed: int = 0):
         prefill_buckets=(16, 32), max_prefills_per_step=2,
         max_admission_rounds=2, decode_steps_per_iter=4, max_inflight=2,
         spec_k=0, prefix_cache_entries=0, sample_topk_cap=8,
+        kv_dtype=kv_dtype,
     )
     engine = InferenceEngine(cfg, params, engine_cfg=ec, eos_id=-1,
                              attn_impl=impl, mesh=mesh)
@@ -330,6 +347,8 @@ class PathReport:
     donated_pages_rebound: bool
     donated_tokens_rebound: bool
     donated_fsm_rebound: bool = True
+    donated_scales_rebound: bool = True
+    kv_quant: str = ""
 
     @property
     def ok(self) -> bool:
@@ -337,7 +356,8 @@ class PathReport:
                 and not any(self.forbidden.values())
                 and self.donated_pages_rebound
                 and self.donated_tokens_rebound
-                and self.donated_fsm_rebound)
+                and self.donated_fsm_rebound
+                and self.donated_scales_rebound)
 
     def as_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -387,6 +407,14 @@ def check_path(decode_path: str) -> PathReport:
     toks_before = engine._tok_state
     fsm_before = engine._fsm_state
     repeat_c, repeat_e = count_new_compiles(engine, repeat)
+    # A quantized pool widens the donation set: the per-page scale leaves
+    # ride along with k/v into every dispatch and must rebind the same way
+    # (a stale scale alias silently dequantizes new pages with old scales).
+    scales_rebound = True
+    if engine.kv_quant:
+        scales_rebound = (
+            engine.pages.k_scale[0] is not pages_before.k_scale[0]
+            and engine.pages.v_scale[0] is not pages_before.v_scale[0])
     report = PathReport(
         decode_path=decode_path,
         warm_compiles=warm_c, warm_events=warm_e,
@@ -398,6 +426,8 @@ def check_path(decode_path: str) -> PathReport:
         donated_pages_rebound=engine.pages is not pages_before,
         donated_tokens_rebound=engine._tok_state is not toks_before,
         donated_fsm_rebound=engine._fsm_state is not fsm_before,
+        donated_scales_rebound=scales_rebound,
+        kv_quant=engine.kv_quant,
     )
     return report
 

@@ -45,6 +45,10 @@ class ReplicaStats:
     # a pre-class replica (treated as all-standard, normal).
     queue_by_class: dict = dataclasses.field(default_factory=dict)
     brownout: int = 0
+    # KV tier snapshot (engine ``kv_tier_stats()``): quant mode, host
+    # spill/restore counters.  Absent on pre-tiering replicas — routing
+    # never requires it; the fleet exporter and migration diagnostics do.
+    kv_tier: dict = dataclasses.field(default_factory=dict)
 
     @property
     def prefix_hit_rate(self) -> float:
@@ -66,6 +70,7 @@ class ReplicaStats:
             prefix_misses=int(pc.get("misses", 0)),
             queue_by_class={str(k): int(v) for k, v in by_class.items()},
             brownout=int(eng.get("brownout", 0)),
+            kv_tier=dict(eng.get("kv_tier") or {}),
         )
 
 
@@ -256,6 +261,7 @@ class ReplicaRegistry:
                     "busy_slots": e.stats.busy_slots,
                     "total_slots": e.stats.total_slots,
                     "prefix_hit_rate": round(e.stats.prefix_hit_rate, 4),
+                    "kv_tier": dict(e.stats.kv_tier),
                 }
                 for rid, e in self._entries.items()
             }

@@ -38,6 +38,7 @@ class Replica:
     replica_id: str = ""
     supports_tokens = False
     supports_query = False
+    supports_kv_migration = False
 
     # -- probing --------------------------------------------------------
 
@@ -71,6 +72,21 @@ class Replica:
         """Verdict history from the replica's standing diagnosis pipeline."""
         raise NotImplementedError(f"{self.replica_id}: query interface")
 
+    # -- KV prefix migration (serving/kv_tier.py blob framing) ----------
+
+    def fetch_prefix(self, token_ids: list[int]):
+        """Framed KV pages for the longest cached prefix of ``token_ids``
+        (``bytes``), or None on a cache miss.  The router's migration path
+        calls this on the prefix-affinity *owner* when dispatch landed
+        elsewhere."""
+        raise NotImplementedError(f"{self.replica_id}: kv migration")
+
+    def install_prefix(self, blob: bytes) -> str:
+        """Install a fetched prefix blob into this replica's KV pool.
+        Returns the engine's outcome string: ``installed`` / ``cached`` /
+        ``incompatible`` / ``nospace``."""
+        raise NotImplementedError(f"{self.replica_id}: kv migration")
+
     def close(self) -> None:
         pass
 
@@ -85,6 +101,7 @@ class LocalReplica(Replica):
     """
 
     supports_tokens = True
+    supports_kv_migration = True
 
     def __init__(self, replica_id: str, service=None, supervisor=None):
         assert (service is None) != (supervisor is None), \
@@ -127,6 +144,7 @@ class LocalReplica(Replica):
             prefix_misses=pc.misses if pc is not None else 0,
             queue_by_class=engine.queue_tokens_by_class(),
             brownout=engine.brownout() if engine.brownout is not None else 0,
+            kv_tier=engine.kv_tier_stats(),
         )
 
     def generate(self, prompt_ids: list[int], sampling=None,
@@ -145,6 +163,28 @@ class LocalReplica(Replica):
         except RuntimeError as exc:
             # Dead service: a routing fact, not a caller error.
             raise ReplicaUnavailable(str(exc)) from exc
+
+    def _call(self, fn):
+        """Engine control call on the step thread (service/supervisor
+        ``call`` seam); death/lifecycle refusals become routing facts."""
+        if self._killed:
+            raise ReplicaUnavailable(f"{self.replica_id}: killed")
+        try:
+            if self.supervisor is not None:
+                return self.supervisor.call(fn)
+            svc = self.service
+            if svc is None:
+                raise ReplicaUnavailable(f"{self.replica_id}: no service")
+            return svc.call(fn)
+        except (RuntimeError, TimeoutError) as exc:
+            raise ReplicaUnavailable(str(exc)) from exc
+
+    def fetch_prefix(self, token_ids: list[int]):
+        ids = list(token_ids)
+        return self._call(lambda e: e.export_prefix(ids))
+
+    def install_prefix(self, blob: bytes) -> str:
+        return self._call(lambda e: e.install_prefix(blob))
 
     def kill(self, reason: str = "injected replica death") -> None:
         """Chaos hook: die abruptly.  Handles for in-flight generations
@@ -168,6 +208,7 @@ class HTTPReplica(Replica):
     queries; explicit timeouts on every socket via ``ApiClient``)."""
 
     supports_query = True
+    supports_kv_migration = True
 
     def __init__(self, replica_id: str, base_url: str, *,
                  connect_timeout_s: float = 2.0, read_timeout_s: float = 30.0,
@@ -216,6 +257,22 @@ class HTTPReplica(Replica):
 
         try:
             return self.client.diagnoses(limit)
+        except ApiConnectionError as exc:
+            raise ReplicaUnavailable(str(exc)) from exc
+
+    def fetch_prefix(self, token_ids: list[int]):
+        from k8s_llm_monitor_tpu.monitor.client import ApiConnectionError
+
+        try:
+            return self.client.kv_prefix(token_ids)
+        except ApiConnectionError as exc:
+            raise ReplicaUnavailable(str(exc)) from exc
+
+    def install_prefix(self, blob: bytes) -> str:
+        from k8s_llm_monitor_tpu.monitor.client import ApiConnectionError
+
+        try:
+            return self.client.kv_install(blob)
         except ApiConnectionError as exc:
             raise ReplicaUnavailable(str(exc)) from exc
 

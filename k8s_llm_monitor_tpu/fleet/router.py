@@ -45,6 +45,7 @@ from typing import Optional
 
 from k8s_llm_monitor_tpu.devtools.lockcheck import guarded_by, make_lock
 from k8s_llm_monitor_tpu.fleet.registry import Candidate, ReplicaRegistry
+from k8s_llm_monitor_tpu.fleet.replica import ReplicaUnavailable
 from k8s_llm_monitor_tpu.resilience.errors import OverloadedError
 from k8s_llm_monitor_tpu.resilience.retry import CircuitOpen
 from k8s_llm_monitor_tpu.serving.engine import GenerationResult, SamplingParams
@@ -202,7 +203,7 @@ _DONE = object()
 
 @guarded_by("_lock", "dispatches", "completed", "failed", "sheds",
             "failovers", "hedges_fired", "hedges_won", "affinity_hits",
-            "affinity_spills", "_ttft_m", "_ttft_dev")
+            "affinity_spills", "_migrations", "_ttft_m", "_ttft_dev")
 class FleetRouter:
     """Routes requests over a ``ReplicaRegistry`` with the selected policy,
     per-replica circuit breaking, optional hedging, and mid-stream
@@ -214,7 +215,8 @@ class FleetRouter:
                  hedge: HedgeConfig | None = None, max_failovers: int = 2,
                  affinity_prefix_tokens: int = 64,
                  stall_timeout_s: float = 120.0,
-                 batch_spill_threshold: float = 0.75):
+                 batch_spill_threshold: float = 0.75,
+                 migrate_prefixes: bool = True):
         if policy not in POLICIES:
             raise ValueError(
                 f"unknown policy {policy!r} (have {sorted(POLICIES)})")
@@ -238,6 +240,13 @@ class FleetRouter:
         self.hedges_won = 0
         self.affinity_hits = 0
         self.affinity_spills = 0
+        # Prefix migration: on an affinity miss, fetch the shared KV
+        # pages from the policy-preferred owner and install them on the
+        # actual target before dispatch (serving/kv_tier.py framing) so
+        # the spilled request still skips its re-prefill.  Outcome
+        # counters feed prefix_migrations_total{outcome}.
+        self.migrate_prefixes = migrate_prefixes
+        self._migrations: dict[str, int] = {}
         # online TTFT stats for the hedge delay
         self._ttft_m: float | None = None
         self._ttft_dev: float = 0.0
@@ -263,6 +272,7 @@ class FleetRouter:
                 "hedges_won": self.hedges_won,
                 "affinity_hits": self.affinity_hits,
                 "affinity_spills": self.affinity_spills,
+                "prefix_migrations": dict(self._migrations),
             }
 
     def _token_digest(self, prompt_ids: list[int]) -> bytes:
@@ -325,6 +335,54 @@ class FleetRouter:
             return
         self._bump("affinity_hits" if chosen == pref else "affinity_spills")
 
+    # -- prefix migration (affinity miss -> move the pages, not the work) -
+
+    def _bump_migration(self, outcome: str) -> None:
+        with self._lock:
+            self._migrations[outcome] = self._migrations.get(outcome, 0) + 1
+
+    def _maybe_migrate_prefix(self, digest: bytes, prompt_ids: list[int],
+                              ranked: list[Candidate]) -> None:
+        """When dispatch is about to land off the affinity owner, pull the
+        owner's cached KV pages for this prompt and install them on the
+        actual target first — the target's prefill then hits its prefix
+        cache instead of recomputing the shared span.  Every failure mode
+        degrades to plain re-prefill; this path must never lose a request.
+        """
+        if not self.migrate_prefixes or len(ranked) < 2:
+            return
+        target = ranked[0]
+        pref = self.policy.preferred(ranked, digest)
+        if pref is None or pref == target.replica_id:
+            return  # hit: the pages are already where the request lands
+        owner = next((c for c in ranked if c.replica_id == pref), None)
+        if (owner is None or not owner.replica.supports_kv_migration
+                or not target.replica.supports_kv_migration):
+            return
+        try:
+            blob = owner.replica.fetch_prefix(prompt_ids)
+        except ReplicaUnavailable:
+            self._bump_migration("owner_down")
+            return
+        except Exception:  # noqa: BLE001 — migration is best-effort
+            logger.exception("prefix fetch from %s failed", pref)
+            self._bump_migration("error")
+            return
+        if blob is None:
+            self._bump_migration("miss")
+            return
+        try:
+            outcome = target.replica.install_prefix(blob)
+        except Exception:  # noqa: BLE001 — migration is best-effort
+            logger.exception("prefix install on %s failed",
+                             target.replica_id)
+            self._bump_migration("error")
+            return
+        self._bump_migration(str(outcome))
+        if outcome == "installed":
+            logger.info("migrated prefix %s... %s -> %s",
+                        digest[:4].hex(), pref, target.replica_id)
+
     # -- token-level dispatch -------------------------------------------
 
     def _dispatch_tokens(self, ranked: list[Candidate],
@@ -378,6 +436,7 @@ class FleetRouter:
         ranked = self._ranked(digest, need_tokens=True, slo_class=slo_class)
         chosen, handle = (None, None)
         if ranked:
+            self._maybe_migrate_prefix(digest, prompt_ids, ranked)
             chosen, handle = self._dispatch_tokens(
                 ranked, prompt_ids, sampling, f"{rid}-a0", deadline_s,
                 slo_class=slo_class)

@@ -36,6 +36,7 @@ from k8s_llm_monitor_tpu.ops.attention import (
     causal_attention,
     gather_pages,
     paged_decode_attention,
+    paged_decode_attention_quant,
 )
 from k8s_llm_monitor_tpu.ops.norms import rms_norm
 from k8s_llm_monitor_tpu.ops.rope import apply_rope, rope_angles
@@ -69,6 +70,13 @@ class KVPages(NamedTuple):
 
     k: list[jnp.ndarray]
     v: list[jnp.ndarray]
+    # Quantized-KV tier (serving/kv_tier.py, docs/serving.md): per-layer
+    # scale arrays [num_blocks, block_size, kv_heads] float32 — one
+    # symmetric scale per (token, head).  Empty tuples (the default) mean
+    # an unquantized pool: no extra pytree leaves, so every pre-existing
+    # jitted program keeps its exact treedef and donation layout.
+    k_scale: tuple | list = ()
+    v_scale: tuple | list = ()
 
     @property
     def num_blocks(self) -> int:
@@ -78,10 +86,69 @@ class KVPages(NamedTuple):
     def block_size(self) -> int:
         return self.k[0].shape[1]
 
+    @property
+    def quantized(self) -> bool:
+        return len(self.k_scale) > 0
 
-def init_kv_pages(cfg: ModelConfig, num_blocks: int, block_size: int) -> KVPages:
-    dtype = jnp.dtype(cfg.kv_dtype or cfg.dtype)
+
+def kv_quant_spec(kv_quant: str) -> tuple[Any, float]:
+    """(storage dtype, qmax) for a KV quantization mode.
+
+    ``int8`` is always available; ``fp8`` selects float8_e4m3fn when this
+    jax build ships it and otherwise falls back to int8 (the engine warns).
+    """
+    if kv_quant == "fp8" and hasattr(jnp, "float8_e4m3fn"):
+        return jnp.dtype(jnp.float8_e4m3fn), 448.0
+    return jnp.dtype(jnp.int8), 127.0
+
+
+def quantize_kv(x: jnp.ndarray, num_kv_heads: int, qdtype,
+                qmax: float) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-(token, head) symmetric quantization of fused-lane KV rows.
+
+    x [..., KVH*D] -> (x_q [..., KVH*D] qdtype, scale [..., KVH] float32).
+    Mirrors ``_quant_act``'s amax/qmax idiom; int8 rounds-and-clips, fp8
+    casts (saturating on TPU).
+    """
+    shp = x.shape
+    D = shp[-1] // num_kv_heads
+    xr = x.astype(jnp.float32).reshape(*shp[:-1], num_kv_heads, D)
+    amax = jnp.max(jnp.abs(xr), axis=-1)
+    scale = jnp.maximum(amax / qmax, 1e-8)
+    xq = xr / scale[..., None]
+    if jnp.dtype(qdtype) == jnp.int8:
+        xq = jnp.clip(jnp.round(xq), -qmax, qmax)
+    return xq.astype(qdtype).reshape(shp), scale
+
+
+def dequantize_kv(x_q: jnp.ndarray, scale: jnp.ndarray,
+                  dtype=jnp.float32) -> jnp.ndarray:
+    """Inverse of ``quantize_kv``: x_q [..., KVH*D] + scale [..., KVH]
+    -> float rows [..., KVH*D]."""
+    shp = x_q.shape
+    KVH = scale.shape[-1]
+    xr = x_q.astype(jnp.float32).reshape(*shp[:-1], KVH, shp[-1] // KVH)
+    return (xr * scale[..., None]).reshape(shp).astype(dtype)
+
+
+def init_kv_pages(cfg: ModelConfig, num_blocks: int, block_size: int,
+                  kv_quant: str = "") -> KVPages:
+    """Allocate the paged KV pool.  ``kv_quant`` ("int8"/"fp8") selects the
+    quantized tier: page arrays in the storage dtype plus per-(token, head)
+    float32 scale arrays; "" keeps the historical unquantized layout."""
     shape = (num_blocks, block_size, cfg.num_kv_heads * cfg.head_dim_)
+    if kv_quant:
+        qdtype, _ = kv_quant_spec(kv_quant)
+        sshape = (num_blocks, block_size, cfg.num_kv_heads)
+        return KVPages(
+            k=[jnp.zeros(shape, qdtype) for _ in range(cfg.num_layers)],
+            v=[jnp.zeros(shape, qdtype) for _ in range(cfg.num_layers)],
+            k_scale=[jnp.zeros(sshape, jnp.float32)
+                     for _ in range(cfg.num_layers)],
+            v_scale=[jnp.zeros(sshape, jnp.float32)
+                     for _ in range(cfg.num_layers)],
+        )
+    dtype = jnp.dtype(cfg.kv_dtype or cfg.dtype)
     return KVPages(
         k=[jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)],
         v=[jnp.zeros(shape, dtype) for _ in range(cfg.num_layers)],
@@ -253,6 +320,17 @@ def is_fused_decode_impl(attn_impl) -> bool:
     return bool(getattr(attn_impl, "fused_decode", False)
                 or getattr(getattr(attn_impl, "func", None),
                            "fused_decode", False))
+
+
+def is_fused_quant_decode_impl(attn_impl) -> bool:
+    """True when ``attn_impl`` is the quantized-KV fused decode kernel
+    (ops/pallas_attention.py:paged_decode_attention_fused_quant — takes
+    page scales, returns updated scales).  A fused impl WITHOUT this marker
+    must never be handed a quantized pool; decode_step falls back to the
+    gather/dequant path in that case."""
+    return bool(getattr(attn_impl, "quant_kv", False)
+                or getattr(getattr(attn_impl, "func", None),
+                           "quant_kv", False))
 
 
 def _expert_weights(p: Params, dtype, act_quant: bool = False):
@@ -626,6 +704,34 @@ def _scatter_pages(
         flat_vals.astype(pages.dtype))
 
 
+def _qmax_for(dtype) -> float:
+    return 127.0 if jnp.dtype(dtype) == jnp.int8 else 448.0
+
+
+def _scatter_pages_quant(
+    pages: jnp.ndarray,
+    spages: jnp.ndarray,
+    vals: jnp.ndarray,
+    block_table: jnp.ndarray,
+    positions: jnp.ndarray,
+    valid: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Quantize-on-append twin of ``_scatter_pages`` for the quantized KV
+    tier: per-(token, head) symmetric quantization of ``vals`` [B, S, KVH, D]
+    into the storage-dtype pages plus a parallel scatter of the float32
+    scales into ``spages`` [num_blocks, bs, KVH].  Values are rounded before
+    the int8 cast (``.astype`` alone truncates toward zero)."""
+    qmax = _qmax_for(pages.dtype)
+    xf = vals.astype(jnp.float32)
+    amax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(amax / qmax, 1e-8)
+    xq = xf / scale[..., None]
+    if jnp.dtype(pages.dtype) == jnp.int8:
+        xq = jnp.clip(jnp.round(xq), -qmax, qmax)
+    return (_scatter_pages(pages, xq, block_table, positions, valid),
+            _scatter_pages(spages, scale, block_table, positions, valid))
+
+
 # ---------------------------------------------------------------------------
 # Prefill
 # ---------------------------------------------------------------------------
@@ -663,23 +769,50 @@ def _prefill_impl(
 
     x = _embed_lookup(params, cfg, tokens)
     uo = cfg.rmsnorm_unit_offset
+    quant = pages.quantized
     new_k, new_v = [], []
+    new_ks, new_vs = [], []
     for li, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps, uo)
         q, k, v = _qkv(layer, cfg, h, cos, sin)
-        pk = _scatter_pages(pages.k[li], k, block_tables, positions, valid)
-        pv = _scatter_pages(pages.v[li], v, block_tables, positions, valid)
+        if quant:
+            pk, psk = _scatter_pages_quant(pages.k[li], pages.k_scale[li],
+                                           k, block_tables, positions, valid)
+            pv, psv = _scatter_pages_quant(pages.v[li], pages.v_scale[li],
+                                           v, block_tables, positions, valid)
+            new_ks.append(psk)
+            new_vs.append(psv)
+        else:
+            pk = _scatter_pages(pages.k[li], k, block_tables, positions,
+                                valid)
+            pv = _scatter_pages(pages.v[li], v, block_tables, positions,
+                                valid)
         new_k.append(pk)
         new_v.append(pv)
-        if attend_to_pages and paged_attn_fn is not None:
+        if attend_to_pages and paged_attn_fn is not None and not quant:
             # Page-streaming path (Pallas verify kernel): queries are
             # contiguous at positions[:, 0] + i, which both verify_step
             # and prefill_chunk guarantee.  (select_verify_impl returns
-            # None for attn-extras models, so no kwargs needed here.)
+            # None for attn-extras models, so no kwargs needed here.
+            # Quantized pools take the gather branch below instead — the
+            # verify kernel has no scale inputs; the engine mirrors this
+            # by dropping its verify impl under kv quant.)
             attn = paged_attn_fn(q, pk, pv, block_tables,
                                  positions[:, 0], lengths)
         else:
-            if attend_to_pages:
+            if attend_to_pages and quant:
+                # Dequantize-on-read: gather pages AND scales, apply the
+                # per-(token, head) scale on the small gathered activation
+                # (never the resident pool).
+                ks = gather_pages(psk, block_tables)       # [B, T, KVH]
+                vs = gather_pages(psv, block_tables)
+                kk = (gather_pages(pk, block_tables).astype(jnp.float32)
+                      .reshape(B, -1, cfg.num_kv_heads, cfg.head_dim_)
+                      * ks[..., None]).astype(k.dtype)
+                vv = (gather_pages(pv, block_tables).astype(jnp.float32)
+                      .reshape(B, -1, cfg.num_kv_heads, cfg.head_dim_)
+                      * vs[..., None]).astype(v.dtype)
+            elif attend_to_pages:
                 # Gathered view is [B, T, KVH*D]; unfuse for attention (the
                 # reshape touches the small gathered activation, never the
                 # resident page arrays).
@@ -695,12 +828,15 @@ def _prefill_impl(
         o = _linear(layer["o"], attn.reshape(B, S, -1), cfg.act_quant)
         x, _ = _residual_tail(layer, cfg, x, o)
 
+    out_pages = KVPages(k=new_k, v=new_v,
+                        k_scale=new_ks if quant else (),
+                        v_scale=new_vs if quant else ())
     if return_all_logits:
-        return _unembed(params, cfg, x), KVPages(k=new_k, v=new_v)
+        return _unembed(params, cfg, x), out_pages
     last_idx = jnp.maximum(lengths - 1, 0)
     x_last = jnp.take_along_axis(x, last_idx[:, None, None], axis=1)  # [B,1,H]
     logits = _unembed(params, cfg, x_last)[:, 0, :]
-    return logits, KVPages(k=new_k, v=new_v)
+    return logits, out_pages
 
 
 def prefill(
@@ -838,15 +974,33 @@ def decode_step(
     active = (context_lens > 0)[:, None]
     cos, sin = rope_angles(positions, cfg.head_dim_, cfg.rope_theta,
                            scaling=cfg.rope_scaling)
-    fused = is_fused_decode_impl(attn_impl)
+    quant = pages.quantized
+    fused_q = quant and is_fused_quant_decode_impl(attn_impl)
+    # A fused impl without scale support must not touch a quantized pool;
+    # fall through to the gather/dequant path instead.
+    fused = is_fused_decode_impl(attn_impl) and (fused_q or not quant)
 
     x = _embed_lookup(params, cfg, tokens)[:, None, :]  # [B, 1, H]
     uo = cfg.rmsnorm_unit_offset
     new_lens = context_lens + 1
     new_k, new_v = [], []
+    new_ks, new_vs = [], []
     for li, layer in enumerate(params["layers"]):
         h = rms_norm(x, layer["input_norm"], cfg.rms_norm_eps, uo)
-        if fused:
+        if fused_q:
+            # Quantized fused fast-path: rope + quantize-on-append +
+            # dequantize-in-kernel attention in one Pallas call; pages AND
+            # scales are updated in place (aliased outputs).
+            q, k, v = _qkv_proj(layer, cfg, h)
+            attn, pk, pv, psk, psv = attn_impl(
+                q, k, v, cos, sin, pages.k[li], pages.v[li],
+                pages.k_scale[li], pages.v_scale[li],
+                block_tables, context_lens)
+            new_k.append(pk)
+            new_v.append(pv)
+            new_ks.append(psk)
+            new_vs.append(psv)
+        elif fused:
             # Fused fast-path: rope + KV append + attention in one Pallas
             # call; the kernel owns the scatter (in-place page update) and
             # the query/new-k rotary math.  Extras models never select
@@ -857,6 +1011,21 @@ def decode_step(
                                      block_tables, context_lens)
             new_k.append(pk)
             new_v.append(pv)
+        elif quant:
+            q, k, v = _qkv(layer, cfg, h, cos, sin)
+            pk, psk = _scatter_pages_quant(pages.k[li], pages.k_scale[li],
+                                           k, block_tables, positions,
+                                           active)
+            pv, psv = _scatter_pages_quant(pages.v[li], pages.v_scale[li],
+                                           v, block_tables, positions,
+                                           active)
+            new_k.append(pk)
+            new_v.append(pv)
+            new_ks.append(psk)
+            new_vs.append(psv)
+            attn = paged_decode_attention_quant(q, pk, pv, psk, psv,
+                                                block_tables, new_lens,
+                                                **_attn_extras(cfg, li))
         else:
             q, k, v = _qkv(layer, cfg, h, cos, sin)
             pk = _scatter_pages(pages.k[li], k, block_tables, positions,
@@ -875,4 +1044,6 @@ def decode_step(
         x, _ = _residual_tail(layer, cfg, x, o)
 
     logits = _unembed(params, cfg, x)[:, 0, :]
-    return logits, KVPages(k=new_k, v=new_v)
+    return logits, KVPages(k=new_k, v=new_v,
+                           k_scale=new_ks if quant else (),
+                           v_scale=new_vs if quant else ())

@@ -585,6 +585,57 @@ class ApiClient:
             path += f"?limit={int(limit)}"
         return self._get_json(path)
 
+    # -- KV prefix migration (POST, never retried) ---------------------------
+
+    def kv_prefix(self, token_ids: list[int]) -> bytes | None:
+        """POST /api/v1/kv/prefix — framed KV pages for the longest
+        cached prefix of ``token_ids`` (serving/kv_tier.py blob), or
+        None on a 404 cache miss."""
+        import urllib.error
+
+        try:
+            with self._open("/api/v1/kv/prefix",
+                            body={"token_ids": [int(t) for t in token_ids]},
+                            timeout=self.read_timeout_s) as resp:
+                return resp.read()
+        except urllib.error.HTTPError as exc:
+            if exc.code == 404:
+                return None
+            over = self._overloaded_from(exc)
+            if over is not None:
+                raise over from exc
+            raise ApiConnectionError(
+                f"POST /api/v1/kv/prefix: HTTP {exc.code}") from exc
+        except (urllib.error.URLError, OSError) as exc:
+            raise ApiConnectionError(
+                f"POST /api/v1/kv/prefix: {exc}") from exc
+
+    def kv_install(self, blob: bytes) -> str:
+        """POST /api/v1/kv/install — raw blob body; returns the engine's
+        outcome string (``installed``/``cached``/``incompatible``/
+        ``nospace``)."""
+        import json as _json
+        import urllib.error
+        import urllib.request
+
+        req = urllib.request.Request(
+            self._url("/api/v1/kv/install"), data=bytes(blob),
+            headers={"Content-Type": "application/octet-stream"})
+        try:
+            with urllib.request.urlopen(  # noqa: S310
+                    req, timeout=self.read_timeout_s) as resp:
+                payload = _json.loads(resp.read().decode())
+        except urllib.error.HTTPError as exc:
+            over = self._overloaded_from(exc)
+            if over is not None:
+                raise over from exc
+            raise ApiConnectionError(
+                f"POST /api/v1/kv/install: HTTP {exc.code}") from exc
+        except (urllib.error.URLError, OSError, ValueError) as exc:
+            raise ApiConnectionError(
+                f"POST /api/v1/kv/install: {exc}") from exc
+        return str(payload.get("outcome", "error"))
+
     # -- queries (POST, never retried) ---------------------------------------
 
     def query(self, question: str,

@@ -84,6 +84,44 @@ def _engine_metrics(w: _Writer, engine) -> None:
                  "Requests whose admission waited for a publishing "
                  "same-prefix lane (cold-burst dedup)",
                  [("", engine.prefix_deferrals)])
+    # KV tiering (serving/kv_tier.py): per-tier byte accounting plus the
+    # spill/restore flow between them.  The host sample is an explicit
+    # NaN when no spill buffer is configured — an absent-vs-zero mixup
+    # across a fleet scrape would hide "this replica cannot spill".
+    tier_fn = getattr(engine, "kv_tier_stats", None)
+    if callable(tier_fn):
+        t = tier_fn()
+        has_host = getattr(engine, "host_kv_tier", None) is not None
+        w.metric("kv_tier_bytes", "gauge",
+                 "KV bytes held per tier (device = configured resident "
+                 "pool incl. quantization scales; host = spilled prefix "
+                 "entries; NaN host = no spill buffer configured)",
+                 [('{tier="device"}', t["device_bytes"]),
+                  ('{tier="host"}', t["host_bytes"] if has_host
+                   else float("nan"))])
+        quant = t["kv_quant"] or "none"
+        w.metric("kv_quant_info", "gauge",
+                 "Resident KV quantization mode and page dtype "
+                 "(1 = active)",
+                 [(f'{{mode="{quant}",dtype="{t["page_dtype"]}"}}', 1)])
+        w.metric("kv_spills_total", "counter",
+                 "Cold prefix entries evicted to the host tier instead of "
+                 "dropped", [("", t["spills"])])
+        w.metric("kv_restores_total", "counter",
+                 "Host-tier prefix entries rehydrated into device pages "
+                 "on a hit", [("", t["restores"])])
+        w.metric("kv_host_lost_total", "counter",
+                 "Host-tier entries dropped under host-buffer pressure "
+                 "(next hit falls back to prompt replay)",
+                 [("", t["host_lost"])])
+    w.metric("engine_chunk_shrinks_total", "counter",
+             "Chunked-prefill rounds shrunk below the configured bucket "
+             "because interactive-class work was queued",
+             [("", getattr(engine, "chunk_shrinks", 0))])
+    w.metric("engine_chunk_bucket", "gauge",
+             "Prefill bucket used by the most recent chunked round "
+             "(0 until a chunked prefill has run)",
+             [("", getattr(engine, "last_chunk_bucket", 0))])
     w.metric("engine_spec_tokens_total", "counter",
              "Tokens emitted by speculative-decode dispatches",
              [("", engine.spec_tokens)])
@@ -344,6 +382,17 @@ def _fleet_metrics(w: _Writer, router) -> None:
     w.metric("fleet_hedge_delay_seconds", "gauge",
              "Current hedge trigger delay (EMA-p95 of TTFT)",
              [("", round(router.hedge_delay_s(), 6))])
+    # Cross-replica prefix migration (PR 10).  All outcomes are emitted
+    # 0-valued from the start so rate() works before the first attempt;
+    # unexpected outcome strings (future engine verdicts) still show up.
+    mig = dict(c.get("prefix_migrations") or {})
+    outcomes = ["installed", "cached", "miss", "owner_down",
+                "incompatible", "nospace", "error"]
+    outcomes += sorted(o for o in mig if o not in outcomes)
+    w.metric("fleet_prefix_migrations_total", "counter",
+             "Prefix migrations attempted on affinity misses, by outcome "
+             "(installed = pages moved instead of re-prefilling)",
+             [(f'{{outcome="{o}"}}', mig.get(o, 0)) for o in outcomes])
 
 
 def _diagnosis_metrics(w: _Writer, pipeline, backend) -> None:

@@ -42,6 +42,7 @@ from k8s_llm_monitor_tpu.monitor.models import (
 from k8s_llm_monitor_tpu.monitor.network import NetworkAnalyzer
 from k8s_llm_monitor_tpu.resilience.errors import OverloadedError
 from k8s_llm_monitor_tpu.resilience.slo import normalize_slo_class
+from k8s_llm_monitor_tpu.serving.kv_tier import BlobError
 
 logger = logging.getLogger("monitor.server")
 
@@ -201,6 +202,7 @@ class MonitorServer:
                     "evictions": pc.evictions,
                     "entries": len(pc),
                 } if pc is not None else None,
+                "kv_tier": engine.kv_tier_stats(),
             }
         router = self.fleet_router()
         if router is not None:
@@ -273,6 +275,8 @@ _ROUTES: dict[tuple[str, str], str] = {
     ("POST", "/api/v1/uav/report"): "h_uav_report",
     ("POST", "/api/v1/uav/command"): "h_uav_command",
     ("GET", "/api/v1/crd/uav"): "h_uav_crd",
+    ("POST", "/api/v1/kv/prefix"): "h_kv_prefix",
+    ("POST", "/api/v1/kv/install"): "h_kv_install",
 }
 _ROUTE_PATHS = {p for _, p in _ROUTES}
 
@@ -706,6 +710,70 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
             # validation errors are the caller's fault; everything else is a
             # server-side failure monitoring clients should retry on
             self._send_json(resp, status=400 if resp.error_kind == "validation" else 500)
+
+        # -- KV prefix migration (serving/kv_tier.py blob framing) --------------
+
+        def _engine_call(self, fn):
+            """Run ``fn(engine)`` on the step thread via the supervisor's
+            (preferred) or service's ``call`` seam; None when this role
+            runs no local engine."""
+            sup = srv.engine_supervisor()
+            if sup is not None:
+                return sup.call(fn)
+            svc = srv.engine_service()
+            if svc is None:
+                raise LookupError("no local engine")
+            return svc.call(fn)
+
+        def h_kv_prefix(self) -> None:
+            """Page-fetch endpoint: body ``{"token_ids": [...]}`` ->
+            framed KV blob (octet-stream) for the longest cached prefix,
+            or 404 on a cache miss.  The fleet router's migration path
+            calls this on the prefix-affinity owner."""
+            try:
+                body = self._read_json() or {}
+            except ValueError:
+                return self._send_error_text("Invalid JSON body", 400)
+            ids = body.get("token_ids")
+            if (not isinstance(ids, list) or not ids
+                    or not all(isinstance(t, int) for t in ids)):
+                return self._send_error_text(
+                    "token_ids must be a non-empty list of ints", 400)
+            try:
+                blob = self._engine_call(
+                    lambda e: e.export_prefix([int(t) for t in ids]))
+            except LookupError:
+                return self._send_error_text(
+                    "Engine not available - running in development mode",
+                    503)
+            if blob is None:
+                return self._send_error_text("no cached prefix", 404)
+            self.send_response(200)
+            self.send_header("Content-Type", "application/octet-stream")
+            self.send_header("Content-Length", str(len(blob)))
+            self.end_headers()
+            self.wfile.write(blob)
+
+        def h_kv_install(self) -> None:
+            """Install a fetched prefix blob (raw octet-stream body) into
+            the local KV pool; responds with the engine's outcome string
+            (``installed``/``cached``/``incompatible``/``nospace``).
+            Framing/CRC damage is the sender's fault: 400."""
+            length = int(self.headers.get("Content-Length", 0) or 0)
+            blob = self.rfile.read(length) if length else b""
+            if not blob:
+                return self._send_error_text("empty blob", 400)
+            try:
+                outcome = self._engine_call(
+                    lambda e: e.install_prefix(blob))
+            except LookupError:
+                return self._send_error_text(
+                    "Engine not available - running in development mode",
+                    503)
+            except BlobError as exc:
+                return self._send_error_text(f"bad blob: {exc}", 400)
+            self._send_json({"status": "success", "outcome": outcome,
+                             "timestamp": _now()})
 
         # -- metrics handlers (CORS like ref :328) ------------------------------
 

@@ -185,6 +185,44 @@ def paged_decode_attention(
                             logit_softcap=logit_softcap, window=window)
 
 
+def paged_decode_attention_quant(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    k_scale: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    block_table: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    scale: float | None = None,
+    logit_softcap: float = 0.0,
+    window: int = 0,
+) -> jnp.ndarray:
+    """Quantized-KV twin of ``paged_decode_attention`` — XLA reference.
+
+    ``k_pages``/``v_pages`` hold int8/fp8 rows; ``k_scale``/``v_scale``
+    are the per-(token, head) float32 scales [num_blocks, bs, KVH]
+    (models/llama.py:KVPages).  Scales are gathered alongside the pages
+    and applied on the small gathered activation — dequantize-on-read,
+    so the resident pool never materializes in float.  Under a GSPMD
+    mesh this partitions automatically when pages and scales both shard
+    their kv-head axis (parallel/sharding.py emits matching specs), which
+    is why the mesh path needs no quant-aware shard_map kernel.
+    """
+    B = q.shape[0]
+    D = q.shape[-1]
+    KVH = k_pages.shape[2] // D
+    ks = gather_pages(k_scale, block_table)            # [B, T, KVH]
+    vs = gather_pages(v_scale, block_table)
+    k = (gather_pages(k_pages, block_table).astype(jnp.float32)
+         .reshape(B, -1, KVH, D) * ks[..., None])
+    v = (gather_pages(v_pages, block_table).astype(jnp.float32)
+         .reshape(B, -1, KVH, D) * vs[..., None])
+    return decode_attention(q, k.astype(q.dtype), v.astype(q.dtype),
+                            lengths, scale=scale,
+                            logit_softcap=logit_softcap, window=window)
+
+
 def paged_verify_attention(
     q: jnp.ndarray,
     k_pages: jnp.ndarray,
@@ -390,7 +428,7 @@ def select_attn_impl(platform: str | None = None, cfg=None, mesh=None):
 
 
 def select_decode_impl(platform: str | None = None, cfg=None, mesh=None,
-                       mode: str = "auto"):
+                       mode: str = "auto", kv_quant: str = ""):
     """Pick the decode-step attention path, including the fused fast-path.
 
     ``mode`` (EngineConfig.decode_path / K8SLLM_DECODE_PATH env):
@@ -406,6 +444,14 @@ def select_decode_impl(platform: str | None = None, cfg=None, mesh=None,
         oracle; also what the fused path is diffed against in tests).
       * ``"pallas"`` — force the split kernel pipeline (Pallas attention
         with the XLA rope/scatter around it).
+
+    ``kv_quant`` ("int8"/"fp8", EngineConfig.kv_dtype) selects the
+    quantized-KV tier: the fused fast-path becomes the quantized fused
+    kernel (quantize-on-append + dequantize-in-kernel, marked
+    ``is_fused_quant_decode_impl``); the split "pallas" pipeline has no
+    scale support and degrades to the gather/dequant reference with a
+    warning.  Non-fused returns are sentinels only — decode_step routes a
+    quantized pool through its own gather/dequant branch.
 
     Returns an attention impl for models/llama.py:decode_step; fused
     impls are marked (``is_fused_decode_impl``) and use the extended
@@ -425,9 +471,24 @@ def select_decode_impl(platform: str | None = None, cfg=None, mesh=None,
                 and cfg.head_dim_ % 2 == 0
                 and _pallas_geometry_ok(cfg, 1))
 
+    def _fused_quant():
+        from k8s_llm_monitor_tpu.ops.pallas_attention import (
+            paged_decode_attention_fused_quant,
+        )
+
+        if platform != "tpu":
+            return functools.partial(paged_decode_attention_fused_quant,
+                                     interpret=True)
+        return paged_decode_attention_fused_quant
+
     if mode == "gather":
         return paged_decode_attention
     if mode == "pallas":
+        if kv_quant:
+            logger.warning(
+                "decode_path='pallas' has no quantized-KV support; the "
+                "split kernel is bypassed for the gather/dequant reference")
+            return paged_decode_attention
         return select_attn_impl(platform, cfg=cfg, mesh=mesh)
     if mode == "fused":
         if not _fused_ok():
@@ -435,6 +496,8 @@ def select_decode_impl(platform: str | None = None, cfg=None, mesh=None,
                 "decode_path='fused' but the model/mesh can't take the "
                 "fused kernel (mesh, attn extras, odd head_dim, or lane "
                 "alignment); use decode_path='auto' for gated selection")
+        if kv_quant:
+            return _fused_quant()
         from k8s_llm_monitor_tpu.ops.pallas_attention import (
             paged_decode_attention_fused,
         )
@@ -449,6 +512,8 @@ def select_decode_impl(platform: str | None = None, cfg=None, mesh=None,
 
     if platform == "tpu" and _fused_ok():
         try:
+            if kv_quant:
+                return _fused_quant()
             from k8s_llm_monitor_tpu.ops.pallas_attention import (
                 paged_decode_attention_fused,
             )
@@ -458,4 +523,9 @@ def select_decode_impl(platform: str | None = None, cfg=None, mesh=None,
             logger.warning(
                 "fused decode kernel failed to import (%s); using the "
                 "split path", exc)
+    if kv_quant:
+        # Mesh or gather regime: decode_step's quant branch gathers pages
+        # AND scales (paged_decode_attention_quant) — GSPMD partitions it
+        # when both shard their kv-head axis.
+        return paged_decode_attention
     return select_attn_impl(platform, cfg=cfg, mesh=mesh)

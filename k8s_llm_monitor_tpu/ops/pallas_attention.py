@@ -582,6 +582,349 @@ def paged_decode_attention_fused(
 paged_decode_attention_fused.fused_decode = True
 
 
+# ---------------------------------------------------------------------------
+# Quantized-KV fused decode: quantize-on-append + dequantize-in-kernel
+# ---------------------------------------------------------------------------
+
+
+def _fused_decode_quant_kernel(
+    H,                     # static: query heads per token
+    D,                     # static: head dim
+    KVH,                   # static: kv heads (= F // D)
+    qmax,                  # static: quant range (127 int8 / 448 fp8)
+    is_int8,               # static: round+clip vs saturating fp8 cast
+    # scalar prefetch
+    tables_ref,            # [B, NB] int32 block ids
+    pos_ref,               # [B] int32 new-token position (0 = inactive lane)
+    # inputs
+    q_ref,                 # [TB, H, F] raw (unroped) block-diagonal queries
+    kn_ref,                # [TB, 1, F] raw fused-lane new-token k
+    vn_ref,                # [TB, 1, F]
+    cos_ref,               # [TB, 1, F]
+    sin_ref,               # [TB, 1, F]
+    k_hbm,                 # [num_blocks, bs, F] quantized (aliased to k_out)
+    v_hbm,
+    ks_hbm,                # [num_blocks, bs, KVH] f32 scales (aliased)
+    vs_hbm,
+    # outputs
+    o_ref,                 # [TB, H, F]
+    k_out,
+    v_out,
+    ks_out,
+    vs_out,
+):
+    """Quantized twin of ``_fused_decode_kernel``.
+
+    Dequantization never expands scales to the F lane dim for the cached
+    pages: per-(token, head) K scales factor out of ``q @ k^T`` (the
+    block-diagonal q restricts head h to its own kv group's lanes), so the
+    score matrix is rescaled by ``scale_bd[h, j] = ks[j, group(h)]`` — one
+    small MXU dot (``onehot_h @ ks_win^T``) per window.  V scales fold into
+    the probabilities the same way: ``acc += (p * vs_bd) @ v_q`` is exact
+    for each head's own group slice (other slices carry garbage the caller
+    slices away) while the softmax denominator uses the unscaled ``p``.
+
+    The appended token is quantized in-kernel (per-head amax over its
+    D-slice) and folded into the softmax as dequantize(quantize(k)) — bit
+    parity with the gather path, which reads the row back dequantized.
+    """
+    TB = q_ref.shape[0]
+    b0 = pl.program_id(0) * TB
+    bs = k_hbm.shape[1]
+    F = q_ref.shape[2]
+    NB = tables_ref.shape[1]
+    W = min(_WINDOW, NB)
+    # Constant index maps: lane j belongs to kv group j // D; head h reads
+    # group h // (H // KVH).
+    lane_group = jax.lax.broadcasted_iota(jnp.int32, (KVH, F), 1) // D
+    grp_row = jax.lax.broadcasted_iota(jnp.int32, (KVH, F), 0)
+    onehot_lane = (lane_group == grp_row).astype(jnp.float32)   # [KVH, F]
+    head_grp = (jax.lax.broadcasted_iota(jnp.int32, (H, KVH), 0)
+                // max(H // KVH, 1))
+    kvh_col = jax.lax.broadcasted_iota(jnp.int32, (H, KVH), 1)
+    onehot_h = (kvh_col == head_grp).astype(jnp.float32)        # [H, KVH]
+
+    def _quantize_row(xf):
+        """xf [1, F] float -> (store [1, F] float pre-cast, scale [1, KVH],
+        dequantized [1, F] f32)."""
+        masked = jnp.where(onehot_lane > 0, jnp.abs(xf), 0.0)   # [KVH, F]
+        amax = jnp.max(masked, axis=1, keepdims=True)           # [KVH, 1]
+        scale = jnp.maximum(amax / qmax, 1e-8)
+        # Lane-expand via one small dot: scale_lane[0, j] = scale[g(j)].
+        scale_lane = jax.lax.dot_general(
+            scale.reshape(1, KVH), onehot_lane, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)                 # [1, F]
+        xq = xf / scale_lane
+        if is_int8:
+            xq = jnp.clip(jnp.round(xq), -qmax, qmax)
+        deq = xq * scale_lane
+        return xq, scale.reshape(1, KVH), deq
+
+    def scoped(k_buf, v_buf, ks_buf, vs_buf, k_row, v_row, ks_row, vs_row,
+               sem, ssem, append_sem):
+        def start_window(slot, b, w):
+            for i in range(W):
+                j = jnp.minimum(w * W + i, NB - 1)
+                blk = tables_ref[b, j]
+                pltpu.make_async_copy(
+                    k_hbm.at[blk], k_buf.at[slot, pl.ds(i * bs, bs)],
+                    sem.at[slot, i, 0]).start()
+                pltpu.make_async_copy(
+                    v_hbm.at[blk], v_buf.at[slot, pl.ds(i * bs, bs)],
+                    sem.at[slot, i, 1]).start()
+                pltpu.make_async_copy(
+                    ks_hbm.at[blk], ks_buf.at[slot, pl.ds(i * bs, bs)],
+                    ssem.at[slot, i, 0]).start()
+                pltpu.make_async_copy(
+                    vs_hbm.at[blk], vs_buf.at[slot, pl.ds(i * bs, bs)],
+                    ssem.at[slot, i, 1]).start()
+
+        def wait_window(slot, b, w):
+            for i in range(W):
+                j = jnp.minimum(w * W + i, NB - 1)
+                blk = tables_ref[b, j]
+                pltpu.make_async_copy(
+                    k_hbm.at[blk], k_buf.at[slot, pl.ds(i * bs, bs)],
+                    sem.at[slot, i, 0]).wait()
+                pltpu.make_async_copy(
+                    v_hbm.at[blk], v_buf.at[slot, pl.ds(i * bs, bs)],
+                    sem.at[slot, i, 1]).wait()
+                pltpu.make_async_copy(
+                    ks_hbm.at[blk], ks_buf.at[slot, pl.ds(i * bs, bs)],
+                    ssem.at[slot, i, 0]).wait()
+                pltpu.make_async_copy(
+                    vs_hbm.at[blk], vs_buf.at[slot, pl.ds(i * bs, bs)],
+                    ssem.at[slot, i, 1]).wait()
+
+        for t in range(TB):
+            b = b0 + t
+            pos = pos_ref[b]
+            active = pos > 0
+
+            cos = cos_ref[t].astype(jnp.float32)
+            sin = sin_ref[t].astype(jnp.float32)
+            q = q_ref[t].astype(jnp.float32)
+            qf = q * cos + _rotate_half_fused(q, D) * sin
+            kn = kn_ref[t].astype(jnp.float32)
+            kf = kn * cos + _rotate_half_fused(kn, D) * sin
+            vf = vn_ref[t].astype(jnp.float32)
+
+            # --- quantize-on-append (per-head amax over the D-slice) ------
+            kq, k_scale, kdeq = _quantize_row(kf)
+            vq, v_scale, vdeq = _quantize_row(vf)
+
+            raw_blk = pos // bs
+            in_table = raw_blk < NB
+            blk = jnp.where(active & in_table,
+                            tables_ref[b, jnp.minimum(raw_blk, NB - 1)], 0)
+            off = jax.lax.rem(pos, bs)
+            k_row[...] = kq.astype(k_row.dtype)
+            v_row[...] = vq.astype(v_row.dtype)
+            ks_row[...] = k_scale
+            vs_row[...] = v_scale
+            copies = [
+                pltpu.make_async_copy(
+                    k_row, k_out.at[blk, pl.ds(off, 1)], append_sem.at[0]),
+                pltpu.make_async_copy(
+                    v_row, v_out.at[blk, pl.ds(off, 1)], append_sem.at[1]),
+                pltpu.make_async_copy(
+                    ks_row, ks_out.at[blk, pl.ds(off, 1)], append_sem.at[2]),
+                pltpu.make_async_copy(
+                    vs_row, vs_out.at[blk, pl.ds(off, 1)], append_sem.at[3]),
+            ]
+            for c in copies:
+                c.start()
+
+            n_blocks = (pos + bs - 1) // bs
+            n_windows = (n_blocks + W - 1) // W
+
+            @pl.when(n_windows > 0)
+            def _first():
+                start_window(0, b, 0)
+
+            def body(w, carry, b=b, pos=pos, n_windows=n_windows):
+                m, l, acc = carry
+                slot = jax.lax.rem(w, 2)
+
+                @pl.when(w + 1 < n_windows)
+                def _prefetch():
+                    start_window(1 - slot, b, w + 1)
+
+                wait_window(slot, b, w)
+                p_idx = (w * (W * bs)
+                         + jax.lax.broadcasted_iota(jnp.int32, (1, W * bs), 1))
+                valid = p_idx < pos
+                kblk = k_buf[slot].astype(jnp.float32)      # quantized ints
+                vblk = v_buf[slot].astype(jnp.float32)
+                # K scales factor out of the contraction: scale_bd[h, j] =
+                # ks[j, group(h)], built as one [H, KVH] x [KVH, W*bs] dot.
+                ks_bd = jax.lax.dot_general(
+                    onehot_h, ks_buf[slot], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)     # [H, W*bs]
+                vs_bd = jax.lax.dot_general(
+                    onehot_h, vs_buf[slot], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                s = jax.lax.dot_general(
+                    qf, kblk, (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32) * ks_bd
+                s = jnp.where(valid, s, NEG_INF)
+                m_cur = jnp.max(s, axis=-1, keepdims=True)
+                m_new = jnp.maximum(m, m_cur)
+                alpha = jnp.exp(m - m_new)
+                p = jnp.exp(s - m_new)
+                l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+                # V scales fold into p; exact on each head's own group
+                # slice, garbage elsewhere (sliced away by the caller).
+                pv = jax.lax.dot_general(
+                    p * vs_bd, vblk, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                return m_new, l_new, alpha * acc + pv
+
+            m0 = jnp.full((H, 1), NEG_INF, jnp.float32)
+            l0 = jnp.zeros((H, 1), jnp.float32)
+            acc0 = jnp.zeros((H, F), jnp.float32)
+            m, l, acc = jax.lax.fori_loop(0, n_windows, body, (m0, l0, acc0))
+
+            # Current token folded as dequant(quant(.)) — parity with the
+            # gather path reading the row back.
+            s_cur = jax.lax.dot_general(
+                qf, kdeq, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)         # [H, 1]
+            m_new = jnp.maximum(m, s_cur)
+            alpha = jnp.exp(m - m_new)
+            p_cur = jnp.exp(s_cur - m_new)
+            l = alpha * l + p_cur
+            acc = alpha * acc + p_cur * vdeq
+
+            for c in copies:
+                c.wait()
+            o_ref[t] = (acc / l).astype(o_ref.dtype)
+
+    pl.run_scoped(
+        scoped,
+        k_buf=pltpu.VMEM((2, W * bs, F), k_hbm.dtype),
+        v_buf=pltpu.VMEM((2, W * bs, F), v_hbm.dtype),
+        # Scale slabs keep the KVH lane dim (sub-128 lanes: Mosaic pads;
+        # the bytes are 1/(2*D) of the page slabs so the padding waste is
+        # bounded and the VMEM cost is noise).
+        ks_buf=pltpu.VMEM((2, W * bs, KVH), jnp.float32),
+        vs_buf=pltpu.VMEM((2, W * bs, KVH), jnp.float32),
+        k_row=pltpu.VMEM((1, F), k_hbm.dtype),
+        v_row=pltpu.VMEM((1, F), v_hbm.dtype),
+        ks_row=pltpu.VMEM((1, KVH), jnp.float32),
+        vs_row=pltpu.VMEM((1, KVH), jnp.float32),
+        sem=pltpu.SemaphoreType.DMA((2, W, 2)),
+        ssem=pltpu.SemaphoreType.DMA((2, W, 2)),
+        append_sem=pltpu.SemaphoreType.DMA((4,)),
+    )
+
+
+def paged_decode_attention_fused_quant(
+    q: jnp.ndarray,
+    k_new: jnp.ndarray,
+    v_new: jnp.ndarray,
+    cos: jnp.ndarray,
+    sin: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    k_scale: jnp.ndarray,
+    v_scale: jnp.ndarray,
+    block_table: jnp.ndarray,
+    positions: jnp.ndarray,
+    *,
+    interpret: bool = False,
+) -> tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Quantized-KV fused decode step (see ``paged_decode_attention_fused``).
+
+    Identical calling convention plus the per-(token, head) float32 scale
+    arrays ``k_scale``/``v_scale`` [num_blocks, bs, KVH], which — like the
+    pages — alias their outputs and update in place.  The engine's donated
+    quantized pool (pages + scales) is therefore never copied; traceguard
+    asserts the rebinding exactly as for the fp16 pool.
+
+    Returns:
+      (attn [B, 1, H, D], k_pages, v_pages, k_scale, v_scale) — the four
+      pool arrays updated in place.
+    """
+    B, S, H, D = q.shape
+    assert S == 1, f"fused decode kernel expects one query token, got {S}"
+    nblk, bs, F = k_pages.shape
+    assert F % D == 0 and D % 2 == 0 and D <= 128, (F, D)
+    KVH = F // D
+    q_per_kv = H // KVH
+    qmax = 127.0 if jnp.dtype(k_pages.dtype) == jnp.int8 else 448.0
+    is_int8 = jnp.dtype(k_pages.dtype) == jnp.int8
+
+    group = jnp.arange(H, dtype=jnp.int32) // q_per_kv
+    onehot = jax.nn.one_hot(group, KVH, dtype=q.dtype)
+    q_bd = (q[:, 0, :, None, :] * (D ** -0.5)
+            * onehot[None, :, :, None]).reshape(B, H, F)
+    kn = k_new.reshape(B, 1, F)
+    vn = v_new.reshape(B, 1, F)
+    cos_f = jnp.tile(cos.astype(jnp.float32), (1, 1, KVH))
+    sin_f = jnp.tile(sin.astype(jnp.float32), (1, 1, KVH))
+
+    budget = 4 * 2**20 // max(H * F * q.dtype.itemsize, 1)
+    TB = next(tb for tb in (8, 4, 2, 1)
+              if B % tb == 0 and (B // tb >= 2 or B == 1)
+              and (tb <= budget or tb == 1))
+    lane_spec = lambda p, tbl, pos: (p, 0, 0)  # noqa: E731
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B // TB,),
+        in_specs=[
+            pl.BlockSpec((TB, H, F), lane_spec),
+            pl.BlockSpec((TB, 1, F), lane_spec),
+            pl.BlockSpec((TB, 1, F), lane_spec),
+            pl.BlockSpec((TB, 1, F), lane_spec),
+            pl.BlockSpec((TB, 1, F), lane_spec),
+            pl.BlockSpec(memory_space=pl.ANY),   # K pages stay in HBM
+            pl.BlockSpec(memory_space=pl.ANY),   # V pages stay in HBM
+            pl.BlockSpec(memory_space=pl.ANY),   # K scales stay in HBM
+            pl.BlockSpec(memory_space=pl.ANY),   # V scales stay in HBM
+        ],
+        out_specs=[
+            pl.BlockSpec((TB, H, F), lane_spec),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+    )
+
+    out_full, k_out, v_out, ks_out, vs_out = pl.pallas_call(
+        functools.partial(_fused_decode_quant_kernel, H, D, KVH, qmax,
+                          is_int8),
+        grid_spec=grid_spec,
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, F), q.dtype),
+            jax.ShapeDtypeStruct(k_pages.shape, k_pages.dtype),
+            jax.ShapeDtypeStruct(v_pages.shape, v_pages.dtype),
+            jax.ShapeDtypeStruct(k_scale.shape, k_scale.dtype),
+            jax.ShapeDtypeStruct(v_scale.shape, v_scale.dtype),
+        ],
+        # Pool arrays update in place: inputs 7..10 (after the 2 scalar-
+        # prefetch operands) alias outputs 1..4.
+        input_output_aliases={7: 1, 8: 2, 9: 3, 10: 4},
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel",),
+        ),
+        interpret=interpret,
+    )(block_table, positions.astype(jnp.int32), q_bd, kn, vn, cos_f, sin_f,
+      k_pages, v_pages, k_scale, v_scale)
+
+    out = jnp.take_along_axis(
+        out_full.reshape(B, 1, H, KVH, D),
+        group[None, None, :, None, None], axis=3)[:, :, :, 0, :]
+    return out, k_out, v_out, ks_out, vs_out
+
+
+# Markers: fused calling convention + quantized-pool variant
+# (models/llama.py:is_fused_decode_impl / is_fused_quant_decode_impl).
+paged_decode_attention_fused_quant.fused_decode = True
+paged_decode_attention_fused_quant.quant_kv = True
+
+
 @functools.partial(jax.jit, static_argnames=("interpret",))
 def paged_verify_attention_pallas(
     q: jnp.ndarray,

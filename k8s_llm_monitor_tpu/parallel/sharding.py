@@ -110,6 +110,15 @@ class SpecLayout:
             return P(None, None, None)
         return P(None, None, self.model_axis)
 
+    def kv_scales(self, num_kv_heads: int, tp: int) -> P:
+        """Quantized-KV scale arrays [num_blocks, block_size, kv_heads]:
+        the kv-heads axis shards exactly when the pages' fused lane dim
+        does (same divisibility condition), so each chip holds the scales
+        for precisely its own head slice; otherwise replicate."""
+        if self.kv_pages(num_kv_heads, tp) == P(None, None, None):
+            return P(None, None, None)
+        return P(None, None, self.model_axis)
+
     def page_table(self) -> P:
         """Block tables / context lengths: replicated.  Page ids are
         GLOBAL — each chip reads the same table and its own head-slice of
@@ -193,9 +202,12 @@ def kv_pages_partition_specs(
     dim on kv-head boundaries (see ``SpecLayout.kv_pages``)."""
     tp = mesh.shape[layout.model_axis] if mesh is not None else 1
     spec = layout.kv_pages(num_kv_heads, tp)
+    sspec = layout.kv_scales(num_kv_heads, tp)
     return KVPages(
         k=[spec for _ in pages.k],
         v=[spec for _ in pages.v],
+        k_scale=[sspec for _ in pages.k_scale],
+        v_scale=[sspec for _ in pages.v_scale],
     )
 
 

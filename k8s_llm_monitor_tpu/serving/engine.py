@@ -52,6 +52,8 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import logging
+import os
 import time
 from typing import Any, Callable, Optional
 
@@ -74,7 +76,15 @@ from k8s_llm_monitor_tpu.serving.kv_cache import (
     BlockAllocator,
     OutOfBlocks,
     PrefixCache,
+    page_slice_bytes,
     shareable_blocks,
+)
+from k8s_llm_monitor_tpu.serving.kv_tier import (
+    BlobError,
+    HostKVTier,
+    SpilledPrefix,
+    pack_prefix_blob,
+    unpack_prefix_blob,
 )
 from k8s_llm_monitor_tpu.serving.spec import (
     AcceptanceEMA,
@@ -82,6 +92,8 @@ from k8s_llm_monitor_tpu.serving.spec import (
     accept_sampled,
     propose_drafts,
 )
+
+logger = logging.getLogger("serving.engine")
 
 
 @dataclasses.dataclass
@@ -159,6 +171,19 @@ class EngineConfig:
     # compatible single TPU chip, split/gather otherwise; "fused",
     # "pallas", "gather" force a path.  K8SLLM_DECODE_PATH overrides.
     decode_path: str = "auto"
+    # Resident KV representation (serving/kv_tier.py rung 1): "auto" keeps
+    # the model-dtype pool (the flag-selectable fp16/bf16 oracle, same
+    # pattern as decode_path); "int8"/"fp8" store pages in the narrow dtype
+    # with per-(token, head) f32 dequant scales — roughly doubling resident
+    # lanes on the same pool bytes (page_slice_bytes accounting).  fp8
+    # falls back to int8 when this jax build lacks float8_e4m3fn.
+    # K8SLLM_KV_DTYPE overrides.
+    kv_dtype: str = "auto"
+    # Host-RAM spill tier capacity in bytes (rung 2): pressured prefix-cache
+    # evictions demote page rows to a HostKVTier of this size instead of
+    # dropping them, and the next hit rehydrates without re-prefill.
+    # 0 disables (pressured evictions drop, as before).
+    host_spill_bytes: int = 0
     # On-device sampling: when every sampling lane of a dispatch has
     # 0 < top_k <= this cap, the decode program samples from the top
     # ``sample_topk_cap`` logits (one lax.top_k) instead of rank-sorting
@@ -177,6 +202,13 @@ class EngineConfig:
     # every waiting first token.  N bounds decode starvation for lanes
     # already generating.  1 = strict alternation, large = prefill-first.
     decode_every_n_chunk_rounds: int = 3
+    # Deadline-aware chunk-round sizing: while any interactive-class
+    # request waits in the pending queue, chunk rounds clamp their token
+    # bucket to this size (rounded up to a prefill bucket) so the queued
+    # interactive work reaches its admission dispatch sooner — a 2048-token
+    # chunk round is a ~2048-token head-of-line block on every admission
+    # behind it.  0 disables (full-bucket rounds, the historical cadence).
+    interactive_chunk_bucket: int = 0
     # Prompt-lookup speculative decoding (serving/spec.py): draft length per
     # verify pass; 0 disables.  Every sampling mode speculates — greedy by
     # argmax match (bit-identical), sampled (incl. top-k/top-p) by the
@@ -340,6 +372,7 @@ class InferenceEngine:
         eos_id: Optional[int] = None,
         attn_impl=None,
         seed: int = 0,
+        host_kv_tier: Optional[HostKVTier] = None,
     ):
         self.cfg = cfg
         self.ecfg = engine_cfg or EngineConfig()
@@ -351,7 +384,23 @@ class InferenceEngine:
         self.token_sink: Optional[TokenSink] = None
 
         ec = self.ecfg
-        pages = llama.init_kv_pages(cfg, ec.num_blocks, ec.block_size)
+        # Resident-KV representation (kv_tier rung 1), resolved before any
+        # pool allocation or program build: ``kv_quant`` is "" for the
+        # model-dtype oracle pool and "int8"/"fp8" for the quantized tier.
+        kvd = os.environ.get("K8SLLM_KV_DTYPE", ec.kv_dtype) or "auto"
+        if kvd in ("auto", "fp16", "bf16", "none"):
+            self.kv_quant = ""
+        elif kvd in ("int8", "fp8"):
+            self.kv_quant = kvd
+            if kvd == "fp8" and not hasattr(jnp, "float8_e4m3fn"):
+                logger.warning(
+                    "kv_dtype=fp8 requested but this jax build has no "
+                    "float8_e4m3fn; falling back to int8 KV")
+        else:
+            raise ValueError(
+                f"unknown kv_dtype {kvd!r} (auto | int8 | fp8)")
+        pages = llama.init_kv_pages(cfg, ec.num_blocks, ec.block_size,
+                                    kv_quant=self.kv_quant)
         # Sequence-sharded prefill (SURVEY §7 step 5): on a mesh with a
         # nontrivial ``seq`` axis, prefill/chunk token batches are placed
         # sharded over ``seq`` — GSPMD then splits the per-position matmul
@@ -393,6 +442,13 @@ class InferenceEngine:
                    for x, s in zip(pages.k, kvspecs.k)],
                 v=[jax.device_put(x, NamedSharding(mesh, s))
                    for x, s in zip(pages.v, kvspecs.v)],
+                # Scale leaves shard their kv-heads axis exactly when the
+                # pages' fused lane dim does (SpecLayout.kv_scales); empty
+                # for unquantized pools.
+                k_scale=[jax.device_put(x, NamedSharding(mesh, s))
+                         for x, s in zip(pages.k_scale, kvspecs.k_scale)],
+                v_scale=[jax.device_put(x, NamedSharding(mesh, s))
+                         for x, s in zip(pages.v_scale, kvspecs.v_scale)],
             )
         self.params = params
         self.pages = pages
@@ -403,22 +459,39 @@ class InferenceEngine:
         # Cold-burst shared-prefix dedup: requests whose admission waited
         # for an in-flight lane to publish their prefix.
         self.prefix_deferrals = 0
+        # Host-RAM spill tier (kv_tier rung 2).  A caller-provided tier
+        # (the supervisor's engine_factory closes over one) survives engine
+        # rebuilds, so spilled prefixes outlive a crash-recovery cycle.
+        if host_kv_tier is None and ec.host_spill_bytes > 0:
+            host_kv_tier = HostKVTier(ec.host_spill_bytes)
+        self.host_kv_tier = host_kv_tier
+        # Rehydration scatter programs, one per (leaf dtype, padded row
+        # count): leaf.at[idx].set(rows) with donated leaf, so a restore
+        # rebinds page leaves in place without changing treedef/sharding.
+        self._tier_write_cache: dict = {}
 
         if attn_impl is None:
-            import os
-
             from k8s_llm_monitor_tpu.ops.attention import select_decode_impl
             # Decode path: the fused RoPE+append+attention kernel on a
             # compatible single TPU chip; under a GSPMD mesh the split
             # kernel runs per-shard via shard_map
             # (ops/attention.py:make_tp_paged_attention) when the KV heads
             # divide the TP degree; otherwise the XLA gather path
-            # partitions automatically.
+            # partitions automatically.  A quantized pool routes to the
+            # fused-quant kernel or the gather/dequant reference
+            # (select_decode_impl kv_quant gate).
             mode = os.environ.get("K8SLLM_DECODE_PATH", ec.decode_path)
-            attn_impl = select_decode_impl(cfg=cfg, mesh=mesh, mode=mode)
+            attn_impl = select_decode_impl(cfg=cfg, mesh=mesh, mode=mode,
+                                           kv_quant=self.kv_quant)
         self._attn_impl = attn_impl
         # "fused" | "pallas" | "gather" — surfaced in /metrics and bench.
-        if llama.is_fused_decode_impl(attn_impl):
+        if self.kv_quant and llama.is_fused_quant_decode_impl(attn_impl):
+            self.decode_path = "fused"
+        elif self.kv_quant:
+            # Quantized pool without the quant kernel: decode_step runs its
+            # gather/dequant branch regardless of the impl handed in.
+            self.decode_path = "gather"
+        elif llama.is_fused_decode_impl(attn_impl):
             self.decode_path = "fused"
         elif getattr(attn_impl, "__name__", "") == "paged_decode_attention":
             self.decode_path = "gather"
@@ -426,7 +499,10 @@ class InferenceEngine:
             self.decode_path = "pallas"
         # Multi-query attention for the speculative verify pass (Pallas
         # kernel on compatible single-chip TPU; XLA gather otherwise).
-        if self.ecfg.spec_k > 0:
+        # Quantized pools drop the dedicated verify kernel: llama's
+        # prefill/verify gather branch dequantizes in-program instead
+        # (models/llama.py _prefill_impl quant gate).
+        if self.ecfg.spec_k > 0 and not self.kv_quant:
             from k8s_llm_monitor_tpu.ops.attention import select_verify_impl
 
             self._verify_impl = select_verify_impl(
@@ -571,6 +647,11 @@ class InferenceEngine:
         self.preemptions_by_class: dict[str, int] = {}
         self.brownout_clamps = 0
         self._chunks_since_decode = 0
+        # Deadline-aware chunk sizing (interactive_chunk_bucket): rounds
+        # clamped because interactive work was queued, and the bucket the
+        # most recent chunk round actually used (exporter gauge + tests).
+        self.chunk_shrinks = 0
+        self.last_chunk_bucket = 0
         # Resilience state (docs/resilience.md).  ``health`` is an optional
         # HealthMonitor attached by EngineService; the engine records
         # watchdog trips and dispatch outcomes into it directly so the
@@ -1078,6 +1159,10 @@ class InferenceEngine:
             self.allocator.free(blocks)
         self._deferred_frees.clear()
         # Cached prefix pages may hold partial writes from the lost calls.
+        # Deliberately NOT spilled to the host tier first — suspect pages
+        # must never be demoted (a poisoned spill would resurface as wrong
+        # KV on restore); already-spilled entries are untouched and stay
+        # restorable after the reset.
         if self.prefix_cache is not None:
             self.prefix_cache.clear()
         for i, s in enumerate(self._slots):
@@ -1201,9 +1286,273 @@ class InferenceEngine:
         cache entries if needed.  Eviction drops the cache's reference; a
         block only returns to the free list when no live slot shares it."""
         while not self.allocator.can_alloc(num_tokens):
-            if self.prefix_cache is None or not self.prefix_cache.evict_lru():
+            if not self._evict_prefix_lru():
                 return False
         return True
+
+    # -- host KV tier (spill / restore, serving/kv_tier.py) --------------
+
+    def _evict_prefix_lru(self) -> bool:
+        """Pressured prefix-cache eviction, demoting to the host tier.
+
+        With a :class:`HostKVTier` attached, the LRU victim's page rows are
+        fetched off-device and stored under its chain digest BEFORE the
+        device-side eviction — the next prompt that would have hit it
+        rehydrates (``_try_restore``) instead of re-prefilling.  The spill
+        is strictly best-effort: any failure degrades to the historical
+        drop (the supervisor's replay machinery re-prefills on demand)."""
+        pc = self.prefix_cache
+        if pc is None:
+            return False
+        tier = self.host_kv_tier
+        if tier is not None:
+            peek = pc.peek_lru()
+            if peek is not None:
+                digest, blocks = peek
+                try:
+                    tier.put(digest, self._fetch_rows(blocks))
+                except Exception as exc:  # noqa: BLE001 — spill must never block eviction
+                    logger.warning("KV spill failed (%s); dropping entry",
+                                   exc)
+        return pc.evict_lru()
+
+    def _fetch_rows(self, blocks: list[int]) -> SpilledPrefix:
+        """Materialize the page rows of ``blocks`` on the host (one gather
+        per pytree leaf; syncs on the dispatch chain, which is exactly the
+        price of demotion).  Under a mesh the fancy-index gather yields the
+        GLOBAL fused-lane rows — page ids are global, so a spilled entry is
+        mesh-shape-portable."""
+        idx = np.asarray(blocks, np.int64)
+        pages = self.pages
+        quant = pages.quantized
+        layers: list[tuple[np.ndarray, ...]] = []
+        for li in range(len(pages.k)):
+            leaf = (pages.k[li], pages.v[li])
+            if quant:
+                leaf += (pages.k_scale[li], pages.v_scale[li])
+            layers.append(tuple(np.asarray(a[idx]) for a in leaf))
+        return SpilledPrefix(n_blocks=len(blocks), layers=layers)
+
+    def _write_rows(self, blocks: list[int], layers: list[tuple]) -> None:
+        """Scatter host rows back into the device pool at ``blocks``,
+        rebinding every page leaf through a donated jitted update so the
+        pool keeps its treedef, shapes, and sharding (zero recompiles of
+        the decode programs).  Rows are padded to a power-of-two count with
+        the out-of-range index ``num_blocks`` (mode="drop") — never index
+        0, whose null block must stay zero."""
+        k = len(blocks)
+        P = 1
+        while P < k:
+            P <<= 1
+        idx = np.full((P,), self.ecfg.num_blocks, np.int32)
+        idx[:k] = blocks
+        idx_dev = jnp.asarray(idx)
+
+        def write(leaf, rows):
+            key = (P, np.dtype(leaf.dtype).name)
+            prog = self._tier_write_cache.get(key)
+            if prog is None:
+                prog = jax.jit(
+                    lambda lf, r, ix: lf.at[ix].set(
+                        r.astype(lf.dtype), mode="drop"),
+                    donate_argnums=(0,))
+                self._tier_write_cache[key] = prog
+            padded = np.zeros((P,) + rows.shape[1:], rows.dtype)
+            padded[:k] = rows
+            return prog(leaf, jnp.asarray(padded), idx_dev)
+
+        pages = self.pages
+        quant = pages.quantized
+        new_k, new_v = list(pages.k), list(pages.v)
+        new_ks, new_vs = list(pages.k_scale), list(pages.v_scale)
+        for li, leaf_rows in enumerate(layers):
+            new_k[li] = write(pages.k[li], leaf_rows[0])
+            new_v[li] = write(pages.v[li], leaf_rows[1])
+            if quant:
+                new_ks[li] = write(pages.k_scale[li], leaf_rows[2])
+                new_vs[li] = write(pages.v_scale[li], leaf_rows[3])
+        self.pages = llama.KVPages(k=new_k, v=new_v,
+                                   k_scale=new_ks if quant else (),
+                                   v_scale=new_vs if quant else ())
+
+    def _try_restore(self, prompt_ids: list[int], shared: list[int],
+                     shared_toks: int) -> tuple[list[int], int]:
+        """Host-tier lookup behind a device prefix-cache miss (or a
+        shorter-than-spilled hit): rehydrate the longest spilled prefix of
+        ``prompt_ids`` into freshly allocated blocks, re-register it, and
+        return the caller-owned span exactly as ``PrefixCache.lookup``
+        would have.  Any failure returns the inputs unchanged — a lost
+        spill is just a miss (replay/re-prefill fallback)."""
+        tier = self.host_kv_tier
+        pc = self.prefix_cache
+        if tier is None or pc is None or len(tier) == 0:
+            return shared, shared_toks
+        bs = self.ecfg.block_size
+        n = shareable_blocks(len(prompt_ids), bs)
+        have = shared_toks // bs
+        if n <= have:
+            return shared, shared_toks
+        digests = pc.digest_chain(prompt_ids, n)
+        for k in range(n, have, -1):
+            dg = digests[k - 1]
+            entry = tier.peek(dg)
+            if entry is None or entry.n_blocks != k:
+                continue
+            if not self._ensure_free(k * bs):
+                return shared, shared_toks
+            try:
+                blocks = self.allocator.alloc(k * bs)
+            except OutOfBlocks:
+                return shared, shared_toks
+            entry = tier.take(dg)
+            if entry is None:  # raced away between peek and take
+                self.allocator.free(blocks)
+                return shared, shared_toks
+            try:
+                self._write_rows(blocks, entry.layers)
+            except Exception as exc:  # noqa: BLE001 — failed restore degrades to a miss
+                logger.warning("KV restore failed (%s); falling back to "
+                               "re-prefill", exc)
+                self.allocator.free(blocks)
+                return shared, shared_toks
+            # Re-publish for every prefix length.  shareable_blocks
+            # guarantees len(prompt_ids) > k*bs, so the +1 slice below is
+            # always in range; the extra token only satisfies the
+            # shareable-span rule (digests cover whole blocks).
+            pc.register(prompt_ids[:k * bs + 1], blocks)
+            if shared:
+                self.allocator.free(shared)
+            return blocks, k * bs
+        return shared, shared_toks
+
+    # -- cross-replica prefix migration (kv_tier rung 3) -----------------
+
+    def _kv_geometry(self) -> dict:
+        """The geometry contract a migration blob must match exactly — a
+        mismatched receiver must refuse the install, never write pages."""
+        cfg, ec = self.cfg, self.ecfg
+        return {
+            "model": cfg.name,
+            "layers": cfg.num_layers,
+            "kv_heads": cfg.num_kv_heads,
+            "head_dim": cfg.head_dim_,
+            "block_size": ec.block_size,
+            "kv_quant": self.kv_quant,
+            "page_dtype": np.dtype(self.pages.k[0].dtype).name,
+        }
+
+    def export_prefix(self, prompt_ids: list[int]) -> Optional[bytes]:
+        """Frame the longest cached prefix of ``prompt_ids`` for a
+        replica-to-replica transfer (the fleet page-fetch endpoint).
+        Returns None on a miss.  The lookup's increfs pin the blocks for
+        the duration of the device fetch, then release — export never
+        changes cache contents."""
+        pc = self.prefix_cache
+        if pc is None:
+            return None
+        shared, shared_toks = pc.lookup(prompt_ids)
+        if not shared:
+            return None
+        try:
+            entry = self._fetch_rows(shared)
+            meta = dict(
+                self._kv_geometry(),
+                n_blocks=len(shared),
+                tokens=[int(t) for t in prompt_ids[:shared_toks]])
+            return pack_prefix_blob(
+                meta, [a for leaf in entry.layers for a in leaf])
+        finally:
+            self.allocator.free(shared)
+
+    def install_prefix(self, blob: bytes) -> str:
+        """Install a migrated prefix blob into the local pool and prefix
+        cache.  Returns an outcome string: ``"installed"`` (pages written
+        and registered), ``"cached"`` (already resident — no work),
+        ``"incompatible"`` (geometry contract mismatch), or ``"nospace"``
+        (pool pressure won).  Framing/CRC damage raises
+        :class:`~..serving.kv_tier.BlobError` — the caller treats a torn
+        transfer as a miss, never a partial install."""
+        meta, raw = unpack_prefix_blob(blob)
+        geo = self._kv_geometry()
+        if any(meta.get(key) != geo[key] for key in geo):
+            return "incompatible"
+        pc = self.prefix_cache
+        cfg, ec = self.cfg, self.ecfg
+        bs = ec.block_size
+        tokens = [int(t) for t in meta.get("tokens", ())]
+        k = int(meta.get("n_blocks", 0))
+        leaves = 4 if self.kv_quant else 2
+        if (pc is None or k <= 0 or len(tokens) != k * bs
+                or len(raw) != cfg.num_layers * leaves):
+            return "incompatible"
+        # The +1 probe/register token never enters a digest (whole blocks
+        # only); it just satisfies the shareable-span rule.
+        probe = tokens + [0]
+        shared, st = pc.lookup(probe)
+        if shared:
+            self.allocator.free(shared)
+            if st >= k * bs:
+                return "cached"
+        F = cfg.num_kv_heads * cfg.head_dim_
+        pdtype = np.dtype(self.pages.k[0].dtype)
+        layers: list[tuple] = []
+        it = iter(raw)
+        try:
+            for _ in range(cfg.num_layers):
+                leaf = (np.frombuffer(next(it), pdtype).reshape(k, bs, F),
+                        np.frombuffer(next(it), pdtype).reshape(k, bs, F))
+                if self.kv_quant:
+                    leaf += (np.frombuffer(next(it), np.float32)
+                             .reshape(k, bs, cfg.num_kv_heads),
+                             np.frombuffer(next(it), np.float32)
+                             .reshape(k, bs, cfg.num_kv_heads))
+                layers.append(leaf)
+        except ValueError as e:
+            raise BlobError(f"ARRAY record does not match geometry: {e}") from e
+        if not self._ensure_free(k * bs):
+            return "nospace"
+        try:
+            blocks = self.allocator.alloc(k * bs)
+        except OutOfBlocks:
+            return "nospace"
+        try:
+            self._write_rows(blocks, layers)
+        except Exception:
+            self.allocator.free(blocks)
+            raise
+        pc.register(probe, blocks)
+        # The cache entries hold their own references now; dropping the
+        # alloc-time ref leaves the pages owned by the cache alone (LRU
+        # evictable, host-spillable) exactly like a locally prefilled span.
+        self.allocator.free(blocks)
+        return "installed"
+
+    def kv_tier_stats(self) -> dict:
+        """Tier byte accounting + spill/restore counters for the exporter
+        (``kv_tier_bytes{tier}`` etc.) and the fleet registry.  Device
+        bytes are the GLOBAL pool (tp=1 view — per-chip slices divide by
+        the mesh's model degree, see ``page_slice_bytes``)."""
+        cfg, ec = self.cfg, self.ecfg
+        pdtype = np.dtype(self.pages.k[0].dtype)
+        page_b = page_slice_bytes(
+            cfg.num_kv_heads, cfg.head_dim_, ec.block_size, pdtype.itemsize,
+            scale_bytes=4 if self.kv_quant else 0)
+        out = {
+            "kv_quant": self.kv_quant,
+            "page_dtype": pdtype.name,
+            "device_bytes": cfg.num_layers * ec.num_blocks * page_b,
+            "host_bytes": 0,
+            "host_entries": 0,
+            "spills": 0,
+            "restores": 0,
+            "host_lost": 0,
+        }
+        if self.host_kv_tier is not None:
+            s = self.host_kv_tier.stats()
+            out.update(host_bytes=s["bytes"], host_entries=s["entries"],
+                       spills=s["spills"], restores=s["restores"],
+                       host_lost=s["lost"])
+        return out
 
     def _pending_prefix_gain(
         self, cand: list[int], publishers: list[list[int]],
@@ -1305,6 +1654,13 @@ class InferenceEngine:
             shared_toks = 0
             if self.prefix_cache is not None:
                 shared, shared_toks = self.prefix_cache.lookup(req.prompt_ids)
+                if self.host_kv_tier is not None:
+                    # A spilled entry longer than the device hit rehydrates
+                    # here, overlapped with the rest of admission prep —
+                    # the scatter is async; the prefill that consumes the
+                    # pages queues behind it on the dispatch chain.
+                    shared, shared_toks = self._try_restore(
+                        req.prompt_ids, shared, shared_toks)
                 suffix = L - shared_toks
 
                 def worth(gain: int) -> bool:
@@ -1525,6 +1881,19 @@ class InferenceEngine:
         P = self._lane_count(len(cands))
         bucket = self._bucket(min(top, max(
             len(s.req.prompt_ids) - s.prefill_pos for _, s in cands)))
+        # Deadline-aware round sizing: queued interactive work shrinks the
+        # round so its admission dispatch isn't head-of-line blocked behind
+        # a full-bucket chunk.  Total chunk work is unchanged — the long
+        # prompt just takes more, shorter rounds while the queue holds
+        # interactive requests.
+        icb = self.ecfg.interactive_chunk_bucket
+        if icb > 0 and any(r.slo_class == "interactive"
+                           for r in self._pending):
+            small = self._bucket(min(icb, top))
+            if small < bucket:
+                bucket = small
+                self.chunk_shrinks += 1
+        self.last_chunk_bucket = bucket
         # Narrow the gathered table to the deepest lane's post-round
         # context: early rounds of a long prompt attend to a fraction of
         # capacity, and the gather cost scales with table width.
@@ -2107,10 +2476,10 @@ class InferenceEngine:
                     self.allocator.extend(s.blocks, s.ctx_pred + steps_i)
                     break
                 except OutOfBlocks:
-                    # Cheapest relief first: drop cached prefixes nobody is
-                    # actively using before draining/preempting live work.
-                    if (self.prefix_cache is not None
-                            and self.prefix_cache.evict_lru()):
+                    # Cheapest relief first: demote cached prefixes nobody
+                    # is actively using to the host tier (or drop them)
+                    # before draining/preempting live work.
+                    if self._evict_prefix_lru():
                         continue
                     self._reconcile_all()
                     if self._slots[i] is not s or s.retired:

@@ -69,7 +69,8 @@ def shareable_blocks(n_tokens: int, block_size: int) -> int:
 
 
 def page_slice_bytes(num_kv_heads: int, head_dim: int, block_size: int,
-                     dtype_bytes: int, tp: int = 1) -> int:
+                     dtype_bytes: int, tp: int = 1,
+                     scale_bytes: int = 0) -> int:
     """Bytes ONE chip holds for ONE logical KV page (K + V) under
     head-dimension sharding.
 
@@ -78,10 +79,16 @@ def page_slice_bytes(num_kv_heads: int, head_dim: int, block_size: int,
     (parallel/sharding.py ``SpecLayout.kv_pages``) and every chip pays the
     full page.  Fit preflight multiplies this by ``num_blocks`` — the
     page-id namespace itself never shrinks with the mesh (global-ids
-    invariant above)."""
+    invariant above).
+
+    ``scale_bytes`` accounts for quantized pools: a per-token-per-head
+    dequant scale array rides each of K and V (models/llama.py KVPages
+    ``k_scale``/``v_scale``, f32 so scale_bytes=4), sharded on the same
+    head boundaries as the pages themselves (``SpecLayout.kv_scales``)."""
     sharded = 1 < tp <= num_kv_heads and num_kv_heads % tp == 0
     heads = num_kv_heads // tp if sharded else num_kv_heads
-    return 2 * block_size * heads * head_dim * dtype_bytes
+    return (2 * block_size * heads * head_dim * dtype_bytes
+            + 2 * block_size * heads * scale_bytes)
 
 
 class OutOfBlocks(Exception):
@@ -204,6 +211,13 @@ class PrefixCache:
     def _shareable_blocks(self, prompt_ids: list[int]) -> int:
         return shareable_blocks(len(prompt_ids), self.allocator.block_size)
 
+    def digest_chain(self, prompt_ids: list[int], n_blocks: int) -> list[bytes]:
+        """Public digest access: the host spill tier (serving/kv_tier.py)
+        and the fleet migration path key their entries by the SAME chain
+        digests lookup walks, so a demoted or migrated prefix is found by
+        the identical probe that would have hit it on-device."""
+        return self._chain_digests(prompt_ids, n_blocks)
+
     def _touch(self, key: bytes, entry: _PrefixEntry) -> None:
         del self._entries[key]
         self._entries[key] = entry
@@ -252,6 +266,16 @@ class PrefixCache:
             shared = blocks[:k]
             self.allocator.incref(shared)
             self._entries[key] = _PrefixEntry(tuple(shared))
+
+    def peek_lru(self) -> tuple[bytes, list[int]] | None:
+        """The LRU entry's (chain digest, block ids) without evicting or
+        touching refcounts — the engine's host-spill wrapper reads the
+        victim's pages off-device *before* calling ``evict_lru`` so a
+        pressured eviction demotes to the host tier instead of dropping."""
+        if not self._entries:
+            return None
+        key = next(iter(self._entries))
+        return key, list(self._entries[key].blocks)
 
     def evict_lru(self) -> bool:
         """Drop the least-recently-used entry (releasing the cache's block

@@ -175,6 +175,13 @@ class EngineService:
         self._faults = get_injector()
         self._submissions: "queue.Queue[GenerationRequest]" = queue.Queue()
         self._cancels: "queue.Queue[str]" = queue.Queue()
+        # Control-plane calls executed ON the step thread (the engine's
+        # only legal toucher): prefix export/install for the fleet
+        # migration path, tier stats snapshots.  Each item is
+        # (fn, reply_queue); the reply carries ("ok", value) or
+        # ("err", exc) back to the blocked caller.
+        self._calls: "queue.Queue[tuple[Callable, queue.Queue]]" = (
+            queue.Queue())
         self._cancelled: set[str] = set()
         self._handles: dict[str, RequestHandle] = {}
         self._ids = itertools.count()
@@ -308,6 +315,49 @@ class EngineService:
         self._cancels.put(request_id)
         self._wake.set()
 
+    # -- control plane ---------------------------------------------------
+
+    def call(self, fn: Callable[[InferenceEngine], object],
+             timeout: float = 30.0):
+        """Run ``fn(engine)`` on the step-loop thread and return its value.
+
+        The step thread is the sole toucher of engine/device state, so
+        anything that reads or writes the KV pool outside the generate
+        path — prefix export for the migration endpoint, host-tier
+        installs, tier stats — must funnel through here rather than
+        calling the engine from an HTTP thread.  Exceptions raised by
+        ``fn`` propagate to the caller; the step loop survives them."""
+        with self._handles_lock:
+            dead = self._dead
+        if dead is not None:
+            raise RuntimeError(f"engine service is dead: {dead}")
+        reply: "queue.Queue[tuple[str, object]]" = queue.Queue(maxsize=1)
+        self._calls.put((fn, reply))
+        self._wake.set()
+        try:
+            kind, value = reply.get(timeout=timeout)
+        except queue.Empty:
+            raise TimeoutError(
+                f"engine call not serviced within {timeout}s") from None
+        if kind == "err":
+            raise value  # type: ignore[misc]
+        return value
+
+    def _drain_calls(self) -> None:
+        while True:
+            try:
+                fn, reply = self._calls.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                out = ("ok", fn(self.engine))
+            except Exception as exc:  # noqa: BLE001 — caller's exception
+                out = ("err", exc)
+            try:
+                reply.put_nowait(out)
+            except queue.Full:  # caller timed out and left; drop it
+                pass
+
     # -- drain / shutdown -----------------------------------------------
 
     def drain(self, timeout: float = 30.0) -> bool:
@@ -344,6 +394,7 @@ class EngineService:
             dead = self._dead
         if dead is None:
             self._fail_all("service stopped")
+            self._fail_calls("service stopped")
 
     # -- loop -----------------------------------------------------------
 
@@ -400,6 +451,7 @@ class EngineService:
                 self.last_heartbeat = time.monotonic()
                 self._faults.maybe_raise("step_loop_crash")
                 self._drain_submissions()
+                self._drain_calls()
                 if self.engine.has_work:
                     self.engine.step()
                 else:
@@ -410,6 +462,7 @@ class EngineService:
             msg = f"engine step failed: {exc!r}"
             with self._handles_lock:
                 self._dead = msg
+            self._fail_calls(msg)
             self.health.set_dead(msg)
             if self.on_death is not None:
                 # A supervisor owns recovery: keep the handles alive so
@@ -424,6 +477,20 @@ class EngineService:
             else:
                 self._fail_all(msg)
                 raise
+
+    def _fail_calls(self, msg: str) -> None:
+        # Control calls that raced the death of the loop error out
+        # immediately instead of blocking their callers until timeout.
+        while True:
+            try:
+                _fn, reply = self._calls.get_nowait()
+            except queue.Empty:
+                return
+            try:
+                reply.put_nowait(
+                    ("err", RuntimeError(f"engine service is dead: {msg}")))
+            except queue.Full:
+                pass
 
     def _fail_all(self, msg: str) -> None:
         # Drain submissions that raced the death of the loop so their

@@ -100,6 +100,15 @@ class EngineSupervisor:
     (weights may be shared; KV pages and host state must not be).  With
     ``max_restarts=0`` a loop death is terminal — equivalent to the
     unsupervised service, plus journaling.
+
+    KV tiering note: a factory that closes over one shared
+    ``HostKVTier`` and passes it as the engine's ``host_kv_tier`` kwarg
+    keeps *spilled* prefix pages alive across rebuilds — the rebuilt
+    engine starts with a fresh device pool but rehydrates demoted
+    prefixes from host RAM on their next hit.  If the tier was lost too
+    (process restart), the replay machinery above is the fallback: the
+    prompt re-prefills from tokens, so a lost spill entry can never lose
+    tokens — only the latency win.
     """
 
     def __init__(
@@ -237,6 +246,33 @@ class EngineSupervisor:
             raise
         tracked.handle = handle
         return handle
+
+    # -- control plane ---------------------------------------------------
+
+    def call(self, fn: Callable[[InferenceEngine], object],
+             timeout: float = 30.0):
+        """Run ``fn(engine)`` on the *current* service's step thread
+        (serving/service.py ``EngineService.call``) — the seam the
+        ``/api/v1/kv`` endpoints use for prefix export/install.  Refused
+        with a retriable OverloadedError while rebuilding: the engine is
+        mid-swap and a call could land on either incarnation."""
+        with self._lock:
+            state = self._state
+        if state == REBUILDING:
+            raise OverloadedError(
+                "engine rebuilding", retriable=True,
+                retry_after_s=self.backoff.delay(0) + 0.5)
+        if state != SERVING:
+            raise OverloadedError(f"lifecycle state {state}",
+                                  retriable=False)
+        try:
+            return self.service.call(fn, timeout=timeout)
+        except RuntimeError as exc:
+            # Service died between the state check and the call: a
+            # rebuild is imminent — same shape as the submit() race.
+            raise OverloadedError(
+                "engine restarting", retriable=True,
+                retry_after_s=self.backoff.delay(0) + 0.5) from exc
 
     # -- progress observation (called from the step-loop thread) ---------
 

@@ -414,14 +414,16 @@ def test_cli_exit_codes(tmp_path, capsys):
 
 @pytest.mark.slow  # builds a real engine (~15s); tier-1 is within ~40s of
 # its timeout budget, so the trace gates run via `make lint-trace` + `make test`
-@pytest.mark.parametrize("decode_path", ["gather", "fused", "mesh"])
+@pytest.mark.parametrize("decode_path", ["gather", "fused", "mesh", "quant"])
 def test_same_bucket_reinvocation_compiles_nothing(decode_path):
     """The acceptance gate: warm both prefill programs + the decode ladder,
     then rerun same-shaped requests with different content — the program
     caches must not grow and no backend compile may fire.  The "mesh" path
     runs the same gate on a GSPMD TP-8 engine over the forced 8-host-device
     mesh (sharded weights + head-sharded KV pages), proving zero recompiles
-    and donated page-pool/token-state rebinding survive sharding."""
+    and donated page-pool/token-state rebinding survive sharding.  The
+    "quant" path runs it on the int8-KV engine, where the donation set also
+    carries the per-page scale leaves."""
     from k8s_llm_monitor_tpu.devtools import traceguard
 
     report = traceguard.check_path(decode_path)
@@ -429,6 +431,9 @@ def test_same_bucket_reinvocation_compiles_nothing(decode_path):
     assert report.repeat_compiles == 0, report.as_dict()
     assert not any(report.forbidden.values()), report.forbidden
     assert report.donated_pages_rebound and report.donated_tokens_rebound
+    assert report.donated_scales_rebound
+    if decode_path == "quant":
+        assert report.kv_quant == "int8"
     assert report.ok
 
 

@@ -1,0 +1,419 @@
+"""KV tiering: quantized resident pages, host-RAM spill/restore, and
+cross-replica prefix migration (serving/kv_tier.py + the engine's tier
+hooks + the fleet router's migration path).
+
+Fast units (blob framing, quant roundtrip, host-tier LRU accounting) run
+in tier-1; engine-level scenarios are slow/chaos-marked and run via
+``make chaos-kvtier`` (K8SLLM_LOCKCHECK=1).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from k8s_llm_monitor_tpu.models import llama
+from k8s_llm_monitor_tpu.models.config import ModelConfig
+from k8s_llm_monitor_tpu.resilience.faults import get_injector
+from k8s_llm_monitor_tpu.resilience.retry import Backoff
+from k8s_llm_monitor_tpu.serving.engine import (
+    EngineConfig,
+    InferenceEngine,
+    SamplingParams,
+)
+from k8s_llm_monitor_tpu.serving.kv_cache import (
+    BlockAllocator,
+    PrefixCache,
+    page_slice_bytes,
+)
+from k8s_llm_monitor_tpu.serving.kv_tier import (
+    BlobError,
+    HostKVTier,
+    SpilledPrefix,
+    pack_prefix_blob,
+    unpack_prefix_blob,
+)
+from k8s_llm_monitor_tpu.serving.supervisor import EngineSupervisor
+
+# Same shapes as tests/test_prefix_cache.py so the jit cache is shared
+# across the cache-focused modules.
+CFG = ModelConfig(name="t", vocab_size=300, hidden_size=32,
+                  intermediate_size=64, num_layers=2, num_heads=4,
+                  num_kv_heads=2, dtype="float32", rope_theta=10_000.0)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(jax.random.PRNGKey(0), CFG)
+
+
+@pytest.fixture(autouse=True)
+def _fault_isolation():
+    get_injector().reset(seed=1234)
+    yield
+    get_injector().reset()
+
+
+def _naive_greedy(params, prompt, n):
+    seq = list(prompt)
+    for _ in range(n):
+        logits = llama.forward_full(params, CFG, jnp.asarray([seq], jnp.int32))
+        seq.append(int(jnp.argmax(logits[0, -1])))
+    return seq[len(prompt):]
+
+
+def _engine(params, **over):
+    kw = dict(max_slots=4, num_blocks=64, block_size=8,
+              max_blocks_per_seq=16, prefill_buckets=(16, 32))
+    kw.update(over)
+    return InferenceEngine(CFG, params, EngineConfig(**kw), eos_id=-1)
+
+
+def _wait(predicate, timeout=30.0, interval=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# Fast units: page accounting, quantization numerics, blob framing, host LRU
+# ---------------------------------------------------------------------------
+
+
+def test_page_slice_bytes_quant_overhead():
+    """int8 pages + f32 per-(token, head) scales vs bf16/f32 pages: the
+    byte math the fit preflight and kv_tier_stats rely on."""
+    kvh, d, bs = 8, 128, 16
+    fp16 = page_slice_bytes(kvh, d, bs, 2)
+    int8 = page_slice_bytes(kvh, d, bs, 1, scale_bytes=4)
+    assert fp16 == 2 * bs * kvh * d * 2
+    assert int8 == 2 * bs * kvh * d * 1 + 2 * bs * kvh * 4
+    # The tentpole economics: ~1.94x more pages per byte at 8B geometry.
+    assert fp16 / int8 > 1.9
+    # Head sharding divides both the pages and the scale rows.
+    assert page_slice_bytes(kvh, d, bs, 1, tp=4, scale_bytes=4) * 4 == int8
+
+
+def test_quantize_dequantize_roundtrip():
+    """Per-(token, head) symmetric int8: dequantize recovers rows within
+    the one-LSB-of-scale bound, zero rows stay exactly zero, and the scale
+    shape drops the head_dim axis."""
+    rng = np.random.default_rng(0)
+    kvh, d = 2, 16
+    x = jnp.asarray(rng.normal(size=(3, 5, kvh * d)) * 4.0, jnp.float32)
+    qdtype, qmax = llama.kv_quant_spec("int8")
+    xq, scale = llama.quantize_kv(x, kvh, qdtype, qmax)
+    assert xq.shape == x.shape and xq.dtype == jnp.int8
+    assert scale.shape == (3, 5, kvh) and scale.dtype == jnp.float32
+    back = llama.dequantize_kv(xq, scale)
+    err = np.abs(np.asarray(back) - np.asarray(x))
+    # Worst case is half an LSB of the per-head scale.
+    bound = np.repeat(np.asarray(scale), d, axis=-1) * 0.51
+    assert (err <= bound).all()
+    zq, zs = llama.quantize_kv(jnp.zeros((1, 4, kvh * d)), kvh, qdtype, qmax)
+    assert not np.asarray(llama.dequantize_kv(zq, zs)).any()
+
+
+def test_blob_roundtrip_and_crc_rejection():
+    meta = {"model": "t", "n_blocks": 2, "tokens": [1, 2, 3]}
+    arrays = [np.arange(12, dtype=np.float32).reshape(2, 6),
+              np.arange(8, dtype=np.int8)]
+    blob = pack_prefix_blob(meta, arrays)
+    out_meta, raw = unpack_prefix_blob(blob)
+    assert out_meta["model"] == "t" and out_meta["version"] == 1
+    assert np.frombuffer(raw[0], np.float32).tolist() == list(range(12))
+    assert np.frombuffer(raw[1], np.int8).tolist() == list(range(8))
+
+    # Any damaged byte must raise, never install garbage.
+    for pos in (0, 5, len(blob) // 2, len(blob) - 1):
+        bad = blob[:pos] + bytes([blob[pos] ^ 0xFF]) + blob[pos + 1:]
+        with pytest.raises(BlobError):
+            unpack_prefix_blob(bad)
+    # Truncation at any point inside a record must raise too.
+    with pytest.raises(BlobError):
+        unpack_prefix_blob(blob[:-3])
+    with pytest.raises(BlobError):
+        unpack_prefix_blob(b"NOPE" + blob[4:])
+
+
+def test_host_tier_lru_byte_cap_and_counters():
+    def entry(nbytes):
+        return SpilledPrefix(
+            n_blocks=1, layers=[(np.zeros(nbytes, np.uint8),)])
+
+    tier = HostKVTier(max_bytes=100)
+    assert not tier.put(b"huge", entry(101))       # can never fit
+    assert tier.put(b"a", entry(40))
+    assert tier.put(b"b", entry(40))
+    assert len(tier) == 2 and tier.bytes_used == 80
+    # Third 40-byte entry displaces the LRU ("a") and counts it lost.
+    assert tier.put(b"c", entry(40))
+    assert tier.contains(b"b") and not tier.contains(b"a")
+    assert tier.stats()["lost"] == 1
+
+    assert tier.peek(b"b") is not None             # peek doesn't consume
+    assert tier.take(b"b").n_blocks == 1           # take does
+    assert tier.take(b"b") is None
+    st = tier.stats()
+    assert st["spills"] == 3 and st["restores"] == 1
+    tier.clear()
+    assert len(tier) == 0 and tier.bytes_used == 0
+    assert tier.stats()["lost"] == 2               # "c" dropped unrestored
+
+
+def test_peek_lru_does_not_evict_or_touch_refcounts():
+    a = BlockAllocator(num_blocks=32, block_size=4)
+    pc = PrefixCache(a, max_entries=8)
+    prompt = list(range(100, 109))                 # 2 full blocks
+    blocks = a.alloc(10)
+    pc.register(prompt, blocks)
+    refs = [a.ref_count(b) for b in blocks[:2]]
+    peek = pc.peek_lru()
+    assert peek is not None
+    digest, victim_blocks = peek
+    assert isinstance(digest, bytes) and victim_blocks
+    assert len(pc) == 2                            # nothing evicted
+    assert [a.ref_count(b) for b in blocks[:2]] == refs
+    # peek's blocks are exactly what evict_lru would free next.
+    assert pc.evict_lru()
+    assert pc.peek_lru() != peek
+    assert PrefixCache(a).peek_lru() is None       # empty cache -> None
+
+
+# ---------------------------------------------------------------------------
+# Engine level: quant parity, spill/restore, rebuild rehydration, migration
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow  # builds engines (jit compiles); runs via make chaos-kvtier
+def test_int8_vs_fp_greedy_parity_budget(params):
+    """Quantized-resident decode against the full-precision oracle on the
+    same weights: greedy outputs must agree on a long prefix.  The budget
+    is explicit — int8 KV is lossy, so divergence deep into a generation
+    is tolerated (median agreement >= 75% of the budget, and the first
+    token always matches); wholesale divergence is a kernel bug."""
+    n_gen = 16
+    eng_fp = _engine(params)
+    eng_q = _engine(params, kv_dtype="int8")
+    assert eng_q.kv_quant == "int8"
+    assert np.dtype(eng_q.pages.k[0].dtype) == np.int8
+    rng = np.random.default_rng(5)
+    agree = []
+    for _ in range(6):
+        p = list(rng.integers(3, 300, size=24))
+        r_fp = eng_fp.generate([list(p)], SamplingParams(max_tokens=n_gen))[0]
+        r_q = eng_q.generate([list(p)], SamplingParams(max_tokens=n_gen))[0]
+        assert r_fp.token_ids == _naive_greedy(params, p, n_gen)
+        k = 0
+        while (k < n_gen and r_fp.token_ids[k] == r_q.token_ids[k]):
+            k += 1
+        assert k >= 1, "first greedy token diverged under int8 KV"
+        agree.append(k / n_gen)
+    assert float(np.median(agree)) >= 0.75, agree
+
+
+@pytest.mark.slow  # builds an engine; runs via make chaos-kvtier
+def test_spill_restore_byte_exact(params):
+    """Pressured evictions demote to the host tier and the next hit
+    rehydrates: cycling more distinct prefixes than the pool holds must
+    spill, restore, and keep every greedy output byte-stable."""
+    eng = _engine(params, max_slots=2, num_blocks=14, block_size=8,
+                  prefill_buckets=(32,), host_spill_bytes=64 << 20,
+                  kv_dtype="int8")
+    rng = np.random.default_rng(6)
+    prompts = [list(rng.integers(3, 300, size=24)) for _ in range(6)]
+    first: dict[int, list[int]] = {}
+    for _ in range(2):
+        for i, p in enumerate(prompts):
+            r = eng.generate([list(p)], SamplingParams(max_tokens=4))[0]
+            assert r.finish_reason == "length"
+            if i in first:
+                assert r.token_ids == first[i], \
+                    f"prompt {i} diverged after spill/restore"
+            else:
+                first[i] = r.token_ids
+    st = eng.kv_tier_stats()
+    assert st["spills"] > 0, st
+    assert st["restores"] > 0, st
+    assert st["host_bytes"] == eng.host_kv_tier.bytes_used
+
+
+@pytest.mark.slow
+@pytest.mark.chaos  # kills the step loop; runs via make chaos-kvtier
+def test_supervisor_rebuild_rehydrates_spilled_pages(params):
+    """A supervisor whose factory shares one HostKVTier across rebuilds:
+    pages spilled before a crash rehydrate into the REBUILT engine's fresh
+    pool (restore counter moves, outputs byte-identical); once the tier is
+    cleared too, the same prompt still completes exactly via tokens-to-
+    prompt replay — a lost spill entry costs latency, never tokens."""
+    tier = HostKVTier(max_bytes=64 << 20)
+    ecfg = dict(max_slots=4, num_blocks=64, block_size=8,
+                max_blocks_per_seq=16, prefill_buckets=(16, 32),
+                max_prefills_per_step=4)
+
+    def factory():
+        return InferenceEngine(CFG, params, EngineConfig(**ecfg), eos_id=-1,
+                               host_kv_tier=tier)
+
+    sup = EngineSupervisor(factory, max_restarts=4,
+                           backoff=Backoff(base_s=0.01, cap_s=0.05,
+                                           jitter=0.0),
+                           poll_interval_s=0.02)
+    try:
+        rng = np.random.default_rng(8)
+        prompt = list(rng.integers(3, 300, size=24))
+        r1 = sup.submit(prompt, SamplingParams(max_tokens=6)).result(
+            timeout=60)
+        assert r1.finish_reason == "length"
+
+        # Demote every cached entry for the prompt to the host tier
+        # (deterministic pressure: the engine's own spill hook).
+        def spill_all(e):
+            n = 0
+            while e._evict_prefix_lru():
+                n += 1
+            return n
+        assert sup.call(spill_all, timeout=30.0) > 0
+        assert len(tier) > 0 and tier.spills > 0
+
+        # Crash the step loop mid-flight; the monitor rebuilds the engine
+        # around the SAME tier.
+        get_injector().arm("step_loop_crash", rate=1.0, times=1)
+        sup.submit(list(rng.integers(3, 300, size=12)),
+                   SamplingParams(max_tokens=3)).result(timeout=60)
+        assert _wait(lambda: sup.restarts == 1)
+        assert _wait(lambda: sup.state == "serving")
+
+        restores0 = tier.restores
+        r2 = sup.submit(list(prompt), SamplingParams(max_tokens=6)).result(
+            timeout=60)
+        assert r2.token_ids == r1.token_ids, "rehydrated pages diverged"
+        assert tier.restores > restores0, "rebuilt engine never restored"
+
+        # Replay fallback: lose the spill buffer, crash again — the prompt
+        # must still produce the exact tokens (plain re-prefill), with
+        # zero duplicated or lost tokens.
+        tier.clear()
+        get_injector().arm("step_loop_crash", rate=1.0, times=1)
+        sup.submit(list(rng.integers(3, 300, size=12)),
+                   SamplingParams(max_tokens=3)).result(timeout=60)
+        assert _wait(lambda: sup.restarts == 2)
+        assert _wait(lambda: sup.state == "serving")
+        r3 = sup.submit(list(prompt), SamplingParams(max_tokens=6)).result(
+            timeout=60)
+        assert r3.token_ids == r1.token_ids
+        assert len(r3.token_ids) == 6
+    finally:
+        sup.shutdown(grace_s=1.0)
+
+
+@pytest.mark.slow  # builds two engines; runs via make chaos-kvtier
+@pytest.mark.parametrize("kv_dtype", ["", "int8"])
+def test_export_install_byte_exact(params, kv_dtype):
+    """Rung three at the engine seam: export the cached prefix from a warm
+    engine, install into a cold one — the receiver hits its prefix cache
+    and reproduces the owner's greedy tokens exactly.  Tampered geometry
+    is refused; damaged framing raises."""
+    over = {"kv_dtype": kv_dtype} if kv_dtype else {}
+    src = _engine(params, **over)
+    dst = _engine(params, **over)
+    rng = np.random.default_rng(9)
+    prompt = list(rng.integers(3, 300, size=24))
+    r_src = src.generate([list(prompt)], SamplingParams(max_tokens=5))[0]
+
+    assert dst.export_prefix(list(prompt)) is None     # cold: nothing cached
+    blob = src.export_prefix(list(prompt))
+    assert blob is not None and blob[:4] == b"KVX1"
+
+    assert dst.install_prefix(blob) == "installed"
+    assert dst.install_prefix(blob) == "cached"        # idempotent
+
+    hits0 = dst.prefix_cache.hits
+    r_dst = dst.generate([list(prompt)], SamplingParams(max_tokens=5))[0]
+    assert r_dst.token_ids == r_src.token_ids
+    assert dst.prefix_cache.hits == hits0 + 1
+
+    # Geometry tamper: same framing, wrong contract -> refused, no write.
+    meta, raw = unpack_prefix_blob(blob)
+    meta.pop("version")
+    bad_meta = dict(meta, block_size=4)
+    tampered = pack_prefix_blob(
+        bad_meta, [np.frombuffer(b, np.uint8) for b in raw])
+    assert dst.install_prefix(tampered) == "incompatible"
+
+    # Torn transfer: must raise, never partially install.
+    with pytest.raises(BlobError):
+        dst.install_prefix(blob[:-7])
+
+
+@pytest.mark.slow
+@pytest.mark.chaos  # kills a replica mid-migration; runs via make chaos-kvtier
+def test_router_migration_outcomes_and_mid_migration_kill(params):
+    """The fleet path: an affinity miss migrates the owner's pages to the
+    dispatch target ("installed"), a re-migration is "cached", and killing
+    the owner mid-migration degrades to "owner_down" — the request still
+    completes exactly via re-prefill on the target."""
+    from k8s_llm_monitor_tpu.fleet.registry import (
+        Candidate,
+        ReplicaRegistry,
+        ReplicaStats,
+    )
+    from k8s_llm_monitor_tpu.fleet.replica import LocalReplica
+    from k8s_llm_monitor_tpu.fleet.router import FleetRouter
+    from k8s_llm_monitor_tpu.serving.service import EngineService
+
+    ecfg = dict(max_slots=4, num_blocks=64, block_size=8,
+                max_blocks_per_seq=16, prefill_buckets=(32,),
+                max_prefills_per_step=4)
+    reps = [LocalReplica(f"r{i}", service=EngineService(
+        InferenceEngine(CFG, params, EngineConfig(**ecfg), eos_id=-1)))
+        for i in range(2)]
+    try:
+        reg = ReplicaRegistry()
+        for r in reps:
+            reg.add(r)
+        reg.refresh()
+        router = FleetRouter(reg, policy="affinity",
+                             affinity_prefix_tokens=16)
+        rng = np.random.default_rng(10)
+        prompt = list(int(t) for t in rng.integers(3, 300, size=17))
+
+        # Warm the owner; then simulate the affinity miss the router sees
+        # when the preferred replica has no free slots.
+        r0 = reps[0].generate(prompt, SamplingParams(max_tokens=4)).result(
+            timeout=60)
+        digest = router._token_digest(prompt)
+        router.policy.preferred = lambda cands, d: "r0"
+        ranked = [Candidate("r1", reps[1], ReplicaStats(total_slots=4), 0),
+                  Candidate("r0", reps[0], ReplicaStats(total_slots=4), 0)]
+
+        router._maybe_migrate_prefix(digest, prompt, ranked)
+        assert router.counters()["prefix_migrations"] == {"installed": 1}
+
+        r1 = reps[1].generate(prompt, SamplingParams(max_tokens=4)).result(
+            timeout=60)
+        assert r1.token_ids == r0.token_ids
+        assert reps[1].service.engine.prefix_cache.hits >= 1
+
+        router._maybe_migrate_prefix(digest, prompt, ranked)
+        assert router.counters()["prefix_migrations"]["cached"] == 1
+
+        # Mid-migration owner death: the fetch fails, the outcome records
+        # owner_down, and the target still serves the prompt exactly.
+        reps[0].kill()
+        router._maybe_migrate_prefix(digest, prompt, ranked)
+        assert router.counters()["prefix_migrations"]["owner_down"] == 1
+        fresh = list(rng.integers(3, 300, size=17))
+        rd = reps[1].generate(fresh, SamplingParams(max_tokens=4)).result(
+            timeout=60)
+        assert rd.finish_reason == "length"
+        assert rd.token_ids == _naive_greedy(params, fresh, 4)
+    finally:
+        for r in reps:
+            r.close()

@@ -354,6 +354,52 @@ def test_decode_progresses_under_chunk_backlog(params):
     assert eng._results["d0"].token_ids == _naive_greedy(params, [5, 6, 7], 60)
 
 
+@pytest.mark.slow  # unique engine shapes recompile; runs via make chaos-overload
+def test_interactive_chunk_bucket_shrinks_rounds(params):
+    """Deadline-aware chunk-round sizing (EngineConfig
+    interactive_chunk_bucket): while an interactive request waits in the
+    queue, a long prompt's chunk rounds drop to the small bucket so the
+    interactive admission isn't head-of-line blocked behind full-bucket
+    chunks.  Total ingest work is unchanged — outputs stay byte-exact —
+    and without queued interactive work the rounds keep the full bucket."""
+    long_prompt = [(3 * j) % 290 + 2 for j in range(48)]
+    eng = _mk_engine(params, max_slots=1, prefill_buckets=(8, 16),
+                     interactive_chunk_bucket=8)
+    eng.submit(GenerationRequest("L0", list(long_prompt),
+                                 SamplingParams(max_tokens=4),
+                                 slo_class="interactive"))
+    eng.step()
+    # No interactive backlog yet: the round used the full top bucket.
+    assert eng.last_chunk_bucket == 16
+    assert eng.chunk_shrinks == 0
+    # An interactive arrival has to queue (the slot is held): every
+    # subsequent chunk round shrinks to the interactive bucket.
+    eng.submit(GenerationRequest("i0", [5, 6, 7],
+                                 SamplingParams(max_tokens=4),
+                                 slo_class="interactive"))
+    eng.step()
+    assert eng.last_chunk_bucket == 8
+    assert eng.chunk_shrinks >= 1
+    _run(eng)
+    assert eng._results["L0"].token_ids == _naive_greedy(
+        params, long_prompt, 4)
+    assert eng._results["i0"].token_ids == _naive_greedy(params, [5, 6, 7], 4)
+
+    # Flag off (default): the same load never shrinks a round, and the
+    # gauge still reports the bucket the last round used.
+    eng2 = _mk_engine(params, max_slots=1, prefill_buckets=(8, 16))
+    eng2.submit(GenerationRequest("L0", list(long_prompt),
+                                  SamplingParams(max_tokens=4),
+                                  slo_class="interactive"))
+    eng2.step()
+    eng2.submit(GenerationRequest("i0", [5, 6, 7],
+                                  SamplingParams(max_tokens=4),
+                                  slo_class="interactive"))
+    _run(eng2)
+    assert eng2.chunk_shrinks == 0
+    assert eng2.last_chunk_bucket == 16
+
+
 # -- brownout effects --------------------------------------------------------
 
 
