@@ -7,6 +7,7 @@ SHELL := /bin/bash    # tier1 uses pipefail/PIPESTATUS
 
 .PHONY: run run-agent run-scheduler demo test test-fast tier1 tier1-mesh \
         chaos chaos-lifecycle chaos-fleet chaos-overload chaos-kvtier \
+        chaos-trace \
         diagnose-e2e bench bench-decode \
         bench-fleet bench-mesh dryrun smoke preflight deploy-agent docker \
         docker-agent docker-scheduler lint lint-trace clean
@@ -83,6 +84,14 @@ chaos-overload:
 chaos-kvtier:
 	$(TEST_ENV) K8SLLM_LOCKCHECK=1 \
 	  $(PY) -m pytest tests/test_kv_tier.py -q -p no:cacheprovider
+
+# Tracing acceptance (docs/observability.md): span-ring bounds, seeded
+# sampling determinism, the live router→2-replica merged trace with a
+# hedge + forced mid-stream failover, flight-recorder dump on a seeded
+# watchdog fault, and exposition lint — with lock discipline checked.
+chaos-trace:
+	$(TEST_ENV) K8SLLM_LOCKCHECK=1 \
+	  $(PY) -m pytest tests/test_tracing.py -q -p no:cacheprovider
 
 # Diagnosis acceptance (docs/diagnosis.md): grammar compiler units, the
 # constrained-sampling fuzz (every sample parses), and the synthetic

@@ -388,6 +388,90 @@ def migration_leg(cfg, params) -> dict:
     }
 
 
+def tracing_leg(cfg, params) -> dict:
+    """Tracing overhead (observability/tracing.py): the identical burst
+    through one engine with span recording fully sampled vs fully off.
+    The delta is the acceptance number — default sampling must cost <2%
+    tok/s.  A throwaway warm-up run absorbs per-engine jit/compile cost
+    so both measured runs see the same caches."""
+    import numpy as np
+
+    from k8s_llm_monitor_tpu.observability.tracing import (
+        Tracer,
+        get_tracer,
+        set_tracer,
+    )
+    from k8s_llm_monitor_tpu.serving.engine import (
+        EngineConfig,
+        InferenceEngine,
+        SamplingParams,
+    )
+    from k8s_llm_monitor_tpu.serving.service import EngineService
+
+    rng = np.random.default_rng(11)
+    t_len = int(os.environ.get("BENCH_TRACE_PROMPT_LEN", "64"))
+    t_gen = int(os.environ.get("BENCH_TRACE_MAX_TOKENS", "32"))
+    t_n = int(os.environ.get("BENCH_TRACE_CONCURRENCY", "16"))
+    t_cap = t_len + t_gen + 16
+    t_ecfg = EngineConfig(
+        max_slots=8,
+        num_blocks=8 * ((t_cap + 15) // 16) + 16,
+        block_size=16,
+        max_blocks_per_seq=(t_cap + 15) // 16,
+        prefill_buckets=(t_len,),
+        max_prefills_per_step=8,
+        decode_steps_per_iter=4,
+    )
+    prompts = [[int(t) for t in
+                rng.integers(4, cfg.vocab_size - 4, size=t_len)]
+               for _ in range(t_n)]
+
+    def run_once(sample: float) -> tuple[float, int]:
+        tracer = Tracer(sample=sample, seed=11)
+        set_tracer(tracer)
+        svc = EngineService(InferenceEngine(cfg, params, t_ecfg, eos_id=-1))
+        try:
+            t0 = time.monotonic()
+            handles = [svc.submit(p, SamplingParams(max_tokens=t_gen))
+                       for p in prompts]
+            for h in handles:
+                res = h.result(timeout=600.0)
+                assert res.finish_reason == "length", res.error
+            wall = time.monotonic() - t0
+        finally:
+            svc.stop(timeout=10.0)
+        return t_n * t_gen / wall, tracer.recorded
+
+    # Interleaved best-of-N pairs: per-span cost is microseconds, so on a
+    # small config a single pair is dominated by scheduler/alloc noise.
+    # Best-of filters that noise from both sides of the comparison.
+    reps = int(os.environ.get("BENCH_TRACE_REPS", "3"))
+    prev = get_tracer()
+    off_tok_s, on_tok_s, spans = 0.0, 0.0, 0
+    try:
+        run_once(1.0)  # warm-up, discarded
+        for _ in range(reps):
+            off, _ = run_once(0.0)
+            on, n_spans = run_once(1.0)
+            off_tok_s = max(off_tok_s, off)
+            if on > on_tok_s:
+                on_tok_s, spans = on, n_spans
+    finally:
+        set_tracer(prev)
+    overhead_pct = (100.0 * (off_tok_s - on_tok_s) / off_tok_s
+                    if off_tok_s > 0 else 0.0)
+    log(f"tracing: sampled {on_tok_s:.1f} tok/s vs off {off_tok_s:.1f} "
+        f"tok/s ({overhead_pct:+.2f}% overhead, {spans} spans; "
+        f"budget < 2%)")
+    return {
+        "tracing_off_tok_s": round(off_tok_s, 1),
+        "tracing_sampled_tok_s": round(on_tok_s, 1),
+        "tracing_overhead_pct": round(overhead_pct, 2),
+        "tracing_spans_recorded": spans,
+        "tracing_overhead_budget_pct": 2.0,
+    }
+
+
 def mesh_leg(cfg, params) -> dict:
     """ICI-sharded serving leg: ONE tensor-parallel engine over every local
     device (weights column/row-sharded, KV pages head-sharded — parallel/
@@ -1746,6 +1830,13 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001 — extras never fail the bench
         log(f"prefix migration leg skipped: {exc}")
 
+    tracing_stats: dict = {}
+    try:
+        if os.environ.get("BENCH_TRACING", "1") == "1":
+            tracing_stats = tracing_leg(cfg, params)
+    except Exception as exc:  # noqa: BLE001 — extras never fail the bench
+        log(f"tracing overhead leg skipped: {exc}")
+
     extras = {
         "model": model_name,
         "quant": quant,
@@ -1869,6 +1960,7 @@ def main() -> None:
     extras.update(fleet_stats)
     extras.update(kv_tier_stats_d)
     extras.update(migration_stats)
+    extras.update(tracing_stats)
     log(f"total bench time {time.monotonic() - t0:.0f}s")
     print(json.dumps({
         "metric": "p50_ttft_100c_ms",

@@ -34,6 +34,13 @@ def _graceful_shutdown(srv, grace_s: float, log: logging.Logger) -> None:
     stops routing new traffic while inflight generations finish — the
     manifest's preStop sleep covers the propagation delay.
     """
+    from k8s_llm_monitor_tpu.observability.flight import get_flight_recorder
+
+    # Last-gasp artifact before teardown mutates any in-flight state; a
+    # dump failure (read-only fs, disk full) must never block the drain.
+    rec = get_flight_recorder()
+    rec.note("sigterm", grace_s=grace_s)
+    rec.dump("sigterm", extra={"grace_s": grace_s})
     watcher = getattr(srv, "diagnosis_watcher", None)
     if watcher is not None:
         watcher.stop()
@@ -126,6 +133,11 @@ def main(argv: list[str] | None = None) -> int:
             log.info("signal %d: router shutting down", signum)
 
             def _stop() -> None:
+                from k8s_llm_monitor_tpu.observability.flight import (
+                    get_flight_recorder)
+
+                get_flight_recorder().dump("sigterm",
+                                           extra={"role": "router"})
                 srv.analysis.close()
                 srv.request_shutdown()
 

@@ -29,6 +29,7 @@ import numpy as np
 from k8s_llm_monitor_tpu.devtools.lockcheck import guarded_by, make_lock
 from k8s_llm_monitor_tpu.monitor.models import EventInfo, utcnow
 from k8s_llm_monitor_tpu.monitor.watcher import EventHandler
+from k8s_llm_monitor_tpu.observability.tracing import get_tracer
 
 logger = logging.getLogger("diagnosis.pipeline")
 
@@ -329,18 +330,36 @@ class DiagnosisPipeline:
 
     def _diagnose_once(self, reasons: list[str], t_trigger: float) -> None:
         uniq = sorted(set(reasons))
-        question = (
-            "A burst of Warning events was just observed "
-            f"(reasons: {', '.join(uniq)}). Identify the most probable "
-            "root cause and the first remediation step."
-        )
-        context = self.context.assemble(question)
-        # Background root-cause work rides the lowest lane: interactive
-        # operators must never queue behind an automatic trigger.
-        verdict = self.analysis.diagnose(question, context=context,
-                                         slo_class="batch")
-        self.queries_total += 1
-        lag_ms = max(0.0, (self._clock() - t_trigger) * 1000.0)
+        tracer = get_tracer()
+        lag_s = max(0.0, self._clock() - t_trigger)
+        with tracer.span("diagnosis.run", root=True,
+                         attrs={"reasons": ", ".join(uniq)[:200],
+                                "n_triggers": len(reasons)}) as run_sp:
+            # Trigger span: queue-wait between burst detection (watcher
+            # thread) and this worker picking it up.  The pipeline clock is
+            # injectable, so the span is rebuilt on the real monotonic axis
+            # from the measured lag rather than trusting t_trigger directly.
+            t_run = time.monotonic()
+            tracer.record("diagnosis.trigger", t_run - lag_s, t_run,
+                          tracer.current(),
+                          attrs={"reasons": ", ".join(uniq)[:200]})
+            question = (
+                "A burst of Warning events was just observed "
+                f"(reasons: {', '.join(uniq)}). Identify the most probable "
+                "root cause and the first remediation step."
+            )
+            with tracer.span("diagnosis.context") as ctx_sp:
+                context = self.context.assemble(question)
+                ctx_sp.attrs["context_chars"] = len(context)
+            # Background root-cause work rides the lowest lane: interactive
+            # operators must never queue behind an automatic trigger.
+            with tracer.span("diagnosis.llm", attrs={"class": "batch"}):
+                verdict = self.analysis.diagnose(question, context=context,
+                                                 slo_class="batch")
+            self.queries_total += 1
+            lag_ms = max(0.0, (self._clock() - t_trigger) * 1000.0)
+            run_sp.attrs["trigger_lag_ms"] = round(lag_ms, 1)
+            run_sp.attrs["severity"] = str(verdict.get("severity", ""))
         self.store.publish(
             verdict, trigger=", ".join(uniq), lag_ms=lag_ms,
             model=getattr(getattr(self.analysis, "backend", None),

@@ -22,6 +22,7 @@ from k8s_llm_monitor_tpu.fleet.replica import HTTPReplica
 from k8s_llm_monitor_tpu.fleet.router import FleetRouter, HedgeConfig
 from k8s_llm_monitor_tpu.monitor.models import (AnalysisRequest,
                                                 AnalysisResponse)
+from k8s_llm_monitor_tpu.observability.tracing import get_tracer
 
 logger = logging.getLogger("fleet.frontend")
 
@@ -47,11 +48,18 @@ class FleetAnalysis:
 
     def query(self, question: str,
               slo_class: str = "interactive") -> AnalysisResponse:
-        return self._to_response(
-            self.router.query(question, slo_class=slo_class))
+        # Root (or joined) span for the text path: the replica's HTTP hop
+        # inherits this context via the ApiClient traceparent header.
+        with get_tracer().span("router.query", attrs={"class": slo_class}):
+            return self._to_response(
+                self.router.query(question, slo_class=slo_class))
 
     def query_stream(self, question: str, slo_class: str = "interactive"):
-        return self.router.query_stream(question, slo_class=slo_class)
+        # The span covers dispatch (replica choice + SSE open); streaming
+        # itself is consumed by the HTTP handler after this returns.
+        with get_tracer().span("router.query_stream",
+                               attrs={"class": slo_class}):
+            return self.router.query_stream(question, slo_class=slo_class)
 
     def analyze(self, request: AnalysisRequest) -> AnalysisResponse:
         return self._to_response(self.router.analyze({

@@ -87,6 +87,14 @@ class Replica:
         ``incompatible`` / ``nospace``."""
         raise NotImplementedError(f"{self.replica_id}: kv migration")
 
+    # -- tracing ---------------------------------------------------------
+
+    def fetch_trace(self, trace_id: str) -> list[dict]:
+        """Span dicts this replica recorded for ``trace_id`` (may be
+        empty).  The router's ``/api/v1/trace/<id>`` merge calls this on
+        every replica to stitch one cross-process timeline."""
+        return []
+
     def close(self) -> None:
         pass
 
@@ -186,6 +194,14 @@ class LocalReplica(Replica):
     def install_prefix(self, blob: bytes) -> str:
         return self._call(lambda e: e.install_prefix(blob))
 
+    def fetch_trace(self, trace_id: str) -> list[dict]:
+        # In-process replicas share the process tracer: the router's
+        # local spans_for() already saw these, and the merge dedups by
+        # span id — returning them again is harmless but pointless.
+        from k8s_llm_monitor_tpu.observability.tracing import get_tracer
+
+        return get_tracer().spans_for(trace_id)
+
     def kill(self, reason: str = "injected replica death") -> None:
         """Chaos hook: die abruptly.  Handles for in-flight generations
         resolve with error results (the router's failover trigger)."""
@@ -275,6 +291,16 @@ class HTTPReplica(Replica):
             return self.client.kv_install(blob)
         except ApiConnectionError as exc:
             raise ReplicaUnavailable(str(exc)) from exc
+
+    def fetch_trace(self, trace_id: str) -> list[dict]:
+        from k8s_llm_monitor_tpu.monitor.client import ApiConnectionError
+
+        try:
+            payload = self.client.trace(trace_id)
+        except ApiConnectionError:
+            return []  # unknown trace / replica down: nothing to merge
+        spans = payload.get("spans") if isinstance(payload, dict) else None
+        return spans if isinstance(spans, list) else []
 
     def close(self) -> None:
         self.client.close()

@@ -46,6 +46,7 @@ from typing import Optional
 from k8s_llm_monitor_tpu.devtools.lockcheck import guarded_by, make_lock
 from k8s_llm_monitor_tpu.fleet.registry import Candidate, ReplicaRegistry
 from k8s_llm_monitor_tpu.fleet.replica import ReplicaUnavailable
+from k8s_llm_monitor_tpu.observability.tracing import Tracer, get_tracer
 from k8s_llm_monitor_tpu.resilience.errors import OverloadedError
 from k8s_llm_monitor_tpu.resilience.retry import CircuitOpen
 from k8s_llm_monitor_tpu.serving.engine import GenerationResult, SamplingParams
@@ -196,6 +197,14 @@ class _Flight:
     attempts: int = 0                   # failovers consumed
     cancelled: bool = False
     dispatch_t0: float = 0.0
+    # TraceContext minted at submit time (child of the caller's context
+    # when one exists).  The pump/hedge threads re-enter it (Tracer.use)
+    # before every replica call so failover replays and hedge legs join
+    # the originating trace — the router's half of the one-merged-trace
+    # contract.  Its own span ("router.request") is recorded when the
+    # flight resolves, so children never point at an unrecorded parent.
+    trace: object = None
+    submit_t0: float = 0.0
 
 
 _DONE = object()
@@ -274,6 +283,17 @@ class FleetRouter:
                 "affinity_spills": self.affinity_spills,
                 "prefix_migrations": dict(self._migrations),
             }
+
+    def replicas(self) -> list[tuple[str, object]]:
+        """(replica_id, Replica) pairs — the cross-replica trace merge in
+        ``GET /api/v1/trace/<id>`` walks every registered replica, ready
+        or not (a replica that died mid-request still holds its spans)."""
+        out = []
+        for rid in self.registry.ids():
+            entry = self.registry.get(rid)
+            if entry is not None:
+                out.append((rid, entry.replica))
+        return out
 
     def _token_digest(self, prompt_ids: list[int]) -> bytes:
         head = prompt_ids[: self.affinity_prefix_tokens]
@@ -359,17 +379,30 @@ class FleetRouter:
         if (owner is None or not owner.replica.supports_kv_migration
                 or not target.replica.supports_kv_migration):
             return
+        tracer = get_tracer()
+        t_mig = time.monotonic()
+
+        def _span(outcome: str, status: str = "ok") -> None:
+            tracer.record(
+                "router.migrate_prefix", t_mig, time.monotonic(),
+                tracer.current(), status=status,
+                attrs={"owner": pref, "target": target.replica_id,
+                       "outcome": outcome})
+
         try:
             blob = owner.replica.fetch_prefix(prompt_ids)
         except ReplicaUnavailable:
             self._bump_migration("owner_down")
+            _span("owner_down", status="error")
             return
         except Exception:  # noqa: BLE001 — migration is best-effort
             logger.exception("prefix fetch from %s failed", pref)
             self._bump_migration("error")
+            _span("fetch_error", status="error")
             return
         if blob is None:
             self._bump_migration("miss")
+            _span("miss")
             return
         try:
             outcome = target.replica.install_prefix(blob)
@@ -377,8 +410,10 @@ class FleetRouter:
             logger.exception("prefix install on %s failed",
                              target.replica_id)
             self._bump_migration("error")
+            _span("install_error", status="error")
             return
         self._bump_migration(str(outcome))
+        _span(str(outcome))
         if outcome == "installed":
             logger.info("migrated prefix %s... %s -> %s",
                         digest[:4].hex(), pref, target.replica_id)
@@ -432,33 +467,68 @@ class FleetRouter:
         a handle whose stream survives replica death transparently."""
         sampling = sampling or SamplingParams()
         rid = request_id or f"fleet-{next(self._ids)}"
+        tracer = get_tracer()
+        # A fresh child of the caller's context (set by the HTTP server
+        # from traceparent), or a new root when the router is where this
+        # request's trace begins.
+        parent = tracer.current()
+        trace = Tracer.child(parent) if parent is not None \
+            else tracer.new_trace()
+        tracer.bind(rid, trace)
         digest = self._token_digest(prompt_ids)
+        t_rank = time.monotonic()
         ranked = self._ranked(digest, need_tokens=True, slo_class=slo_class)
         chosen, handle = (None, None)
-        if ranked:
-            self._maybe_migrate_prefix(digest, prompt_ids, ranked)
-            chosen, handle = self._dispatch_tokens(
-                ranked, prompt_ids, sampling, f"{rid}-a0", deadline_s,
-                slo_class=slo_class)
+        with tracer.use(trace):
+            if ranked:
+                self._maybe_migrate_prefix(digest, prompt_ids, ranked)
+                chosen, handle = self._dispatch_tokens(
+                    ranked, prompt_ids, sampling, f"{rid}-a0", deadline_s,
+                    slo_class=slo_class)
         if chosen is None:
             self._bump("sheds")
+            self._end_flight_span_at(trace, rid, t_rank, "error",
+                                     outcome="shed")
             err = handle  # last error from dispatch, or None when empty
             if isinstance(err, OverloadedError):
                 raise err
             raise OverloadedError(
                 f"no replica available ({err or 'fleet empty'})",
-                retriable=True, retry_after_s=1.0, slo_class=slo_class)
+                retriable=True, retry_after_s=1.0, slo_class=slo_class,
+                request_id=rid)
         self._account_affinity(digest, chosen, ranked)
+        tracer.record("router.dispatch", t_rank, time.monotonic(), trace,
+                      attrs={"request_id": rid, "replica": chosen,
+                             "attempt": 0, "class": slo_class})
 
         flight = _Flight(
             rid=rid, prompt_ids=list(prompt_ids), sampling=sampling,
             deadline_s=deadline_s, digest=digest, slo_class=slo_class,
             handle=RequestHandle(rid, eos_id=None), inner=handle,
-            replica_id=chosen, dispatch_t0=time.monotonic())
+            replica_id=chosen, dispatch_t0=time.monotonic(), trace=trace,
+            submit_t0=t_rank)
         flight.handle._cancel_fn = lambda _rid: self._cancel_flight(flight)
         threading.Thread(target=self._pump, args=(flight,),
                          name=f"fleet-pump-{rid}", daemon=True).start()
         return flight.handle
+
+    @staticmethod
+    def _end_flight_span_at(trace, rid: str, t0: float, status: str,
+                            **attrs) -> None:
+        """Record the flight's own span (the context's span id itself, so
+        every child span recorded under it has a real parent)."""
+        if trace is None:
+            return
+        attrs["request_id"] = rid
+        get_tracer().record(
+            "router.request", t0, time.monotonic(), trace, status=status,
+            span_id=trace.span_id, parent_id=trace.parent_id, attrs=attrs)
+
+    def _end_flight_span(self, fl: _Flight, status: str, **attrs) -> None:
+        self._end_flight_span_at(fl.trace, fl.rid, fl.submit_t0, status,
+                                 replica=fl.replica_id,
+                                 attempts=fl.attempts,
+                                 tokens=len(fl.emitted), **attrs)
 
     def _cancel_flight(self, fl: _Flight) -> None:
         fl.cancelled = True
@@ -469,42 +539,55 @@ class FleetRouter:
     # -- pump: stream, hedge, fail over ---------------------------------
 
     def _pump(self, fl: _Flight) -> None:
+        # Pump threads are born context-less: re-enter the flight's trace
+        # so the replica calls below (failover resubmits, hedge legs,
+        # their HTTP hops) carry the originating traceparent.
+        tracer = get_tracer()
         try:
-            while True:
-                outcome = self._consume(fl)
-                if outcome is _DONE:
-                    return
-                # Replica died mid-generation: fold emitted tokens into the
-                # prompt, trim the budget, resubmit elsewhere (supervisor
-                # replay contract, fleet-wide).
-                self.registry.note_done(fl.replica_id, ok=False)
-                self.registry.mark_unready(fl.replica_id, str(outcome))
-                self._bump("failovers")
-                fl.attempts += 1
-                if fl.cancelled:
-                    return self._fail(fl, "cancelled")
-                if fl.attempts > self.max_failovers:
-                    return self._fail(
-                        fl, f"failover budget exhausted: {outcome}")
-                remaining = fl.sampling.max_tokens - len(fl.emitted)
-                if remaining <= 0:
-                    return self._finish_trimmed(fl)
-                replay = dataclasses.replace(
-                    fl.sampling, max_tokens=remaining)
-                ranked = self._ranked(fl.digest, need_tokens=True,
-                                      slo_class=fl.slo_class)
-                chosen, handle = self._dispatch_tokens(
-                    ranked, fl.prompt_ids + fl.emitted, replay,
-                    f"{fl.rid}-a{fl.attempts}", fl.deadline_s,
-                    exclude={fl.replica_id}, slo_class=fl.slo_class)
-                if chosen is None:
-                    return self._fail(
-                        fl, f"no healthy replica for failover ({handle})")
-                logger.info("request %s failed over %s -> %s after %d tokens",
-                            fl.rid, fl.replica_id, chosen, len(fl.emitted))
-                fl.prior = list(fl.emitted)
-                fl.replica_id, fl.inner = chosen, handle
-                fl.dispatch_t0 = time.monotonic()
+            with tracer.use(fl.trace):
+                while True:
+                    outcome = self._consume(fl)
+                    if outcome is _DONE:
+                        return
+                    # Replica died mid-generation: fold emitted tokens into
+                    # the prompt, trim the budget, resubmit elsewhere
+                    # (supervisor replay contract, fleet-wide).
+                    self.registry.note_done(fl.replica_id, ok=False)
+                    self.registry.mark_unready(fl.replica_id, str(outcome))
+                    self._bump("failovers")
+                    fl.attempts += 1
+                    if fl.cancelled:
+                        return self._fail(fl, "cancelled")
+                    if fl.attempts > self.max_failovers:
+                        return self._fail(
+                            fl, f"failover budget exhausted: {outcome}")
+                    remaining = fl.sampling.max_tokens - len(fl.emitted)
+                    if remaining <= 0:
+                        return self._finish_trimmed(fl)
+                    replay = dataclasses.replace(
+                        fl.sampling, max_tokens=remaining)
+                    t_fo = time.monotonic()
+                    ranked = self._ranked(fl.digest, need_tokens=True,
+                                          slo_class=fl.slo_class)
+                    chosen, handle = self._dispatch_tokens(
+                        ranked, fl.prompt_ids + fl.emitted, replay,
+                        f"{fl.rid}-a{fl.attempts}", fl.deadline_s,
+                        exclude={fl.replica_id}, slo_class=fl.slo_class)
+                    if chosen is None:
+                        return self._fail(
+                            fl, f"no healthy replica for failover ({handle})")
+                    tracer.record(
+                        "router.failover", t_fo, time.monotonic(), fl.trace,
+                        attrs={"request_id": fl.rid, "from": fl.replica_id,
+                               "to": chosen, "attempt": fl.attempts,
+                               "tokens_folded": len(fl.emitted),
+                               "cause": str(outcome)[:200]})
+                    logger.info(
+                        "request %s failed over %s -> %s after %d tokens",
+                        fl.rid, fl.replica_id, chosen, len(fl.emitted))
+                    fl.prior = list(fl.emitted)
+                    fl.replica_id, fl.inner = chosen, handle
+                    fl.dispatch_t0 = time.monotonic()
         except Exception:  # noqa: BLE001 — a pump must never strand a caller
             logger.exception("pump for %s crashed", fl.rid)
             self._fail(fl, "router pump error")
@@ -545,6 +628,9 @@ class FleetRouter:
                 self.registry.note_done(
                     fl.replica_id, ok=res.finish_reason != "error")
                 self._bump("completed")
+                self._end_flight_span(
+                    fl, "error" if res.finish_reason == "error" else "ok",
+                    finish_reason=res.finish_reason)
                 return _DONE
             if not fl.emitted and not fl.prior:
                 self._note_ttft(time.monotonic() - fl.dispatch_t0)
@@ -574,6 +660,7 @@ class FleetRouter:
             # else: stream ended inside the delay window (poll_token
             # re-armed the end sentinel for _consume).  Nothing to hedge.
             return None
+        t_hedge = time.monotonic()
         ranked = self._ranked(fl.digest, need_tokens=True,
                               slo_class=fl.slo_class)
         chosen, hedge_handle = self._dispatch_tokens(
@@ -586,6 +673,11 @@ class FleetRouter:
             fl.replica_id, primary, chosen, hedge_handle)
         if winner is hedge_handle:
             self._bump("hedges_won")
+        get_tracer().record(
+            "router.hedge", t_hedge, time.monotonic(), fl.trace,
+            attrs={"request_id": fl.rid, "primary": fl.replica_id,
+                   "hedge": chosen, "winner": winner_id,
+                   "delay_s": round(delay, 6)})
         loser.cancel()
         # The loser keeps running to its (cancelled) completion on its own
         # replica; release the router-side inflight slot now.  Cancellation
@@ -619,6 +711,7 @@ class FleetRouter:
 
     def _fail(self, fl: _Flight, msg: str) -> None:
         self._bump("failed")
+        self._end_flight_span(fl, "error", error=msg[:200])
         fl.handle._replay_prefix = []
         fl.handle._push([], GenerationResult(
             request_id=fl.rid, token_ids=list(fl.emitted),
@@ -627,6 +720,7 @@ class FleetRouter:
     def _finish_trimmed(self, fl: _Flight) -> None:
         """The dying replica had already emitted the full budget: complete
         with what was streamed (nothing left to regenerate)."""
+        self._end_flight_span(fl, "ok", finish_reason="length")
         fl.handle._replay_prefix = []
         fl.handle._push([], GenerationResult(
             request_id=fl.rid, token_ids=list(fl.emitted),

@@ -461,13 +461,23 @@ class ApiClient:
         import urllib.request
 
         data = None
-        headers = {}
+        headers = self._trace_headers()
         if body is not None:
             data = _json.dumps(body).encode()
             headers["Content-Type"] = "application/json"
         req = urllib.request.Request(self._url(path), data=data,
                                      headers=headers)
         return urllib.request.urlopen(req, timeout=timeout)  # noqa: S310
+
+    @staticmethod
+    def _trace_headers() -> dict[str, str]:
+        """W3C traceparent for the calling thread's current context, so
+        every outbound hop (probes, queries, hedge legs, failover
+        replays, KV migration) joins the originating trace."""
+        from k8s_llm_monitor_tpu.observability.tracing import get_tracer
+
+        tp = get_tracer().current_traceparent()
+        return {"traceparent": tp} if tp else {}
 
     def _retry_hint_s(self, server_hint_s: float, slo_class: str) -> float:
         """Client-side retry delay from the server's per-class hint:
@@ -511,6 +521,7 @@ class ApiClient:
             retriable=exc.code == 429,
             retry_after_s=self._retry_hint_s(hint, slo_class),
             slo_class=slo_class,
+            request_id=str(payload.get("request_id", "") or ""),
         )
 
     def _get_json(self, path: str) -> dict[str, Any]:
@@ -585,6 +596,17 @@ class ApiClient:
             path += f"?limit={int(limit)}"
         return self._get_json(path)
 
+    def trace(self, ref: str) -> dict[str, Any]:
+        """GET /api/v1/trace/<ref> — spans for a request or trace id
+        (the router's cross-replica merge source)."""
+        from urllib.parse import quote
+
+        return self._get_json(f"/api/v1/trace/{quote(ref, safe='')}")
+
+    def traces(self, limit: int = 20) -> dict[str, Any]:
+        """GET /api/v1/trace — recent traces in the replica's ring."""
+        return self._get_json(f"/api/v1/trace?limit={int(limit)}")
+
     # -- KV prefix migration (POST, never retried) ---------------------------
 
     def kv_prefix(self, token_ids: list[int]) -> bytes | None:
@@ -618,9 +640,11 @@ class ApiClient:
         import urllib.error
         import urllib.request
 
+        headers = self._trace_headers()
+        headers["Content-Type"] = "application/octet-stream"
         req = urllib.request.Request(
             self._url("/api/v1/kv/install"), data=bytes(blob),
-            headers={"Content-Type": "application/octet-stream"})
+            headers=headers)
         try:
             with urllib.request.urlopen(  # noqa: S310
                     req, timeout=self.read_timeout_s) as resp:

@@ -19,6 +19,7 @@ constraint (stdlib + jax only) matches the rest of the monitor plane.
 from __future__ import annotations
 
 import math
+import re
 from typing import TYPE_CHECKING
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -26,10 +27,18 @@ if TYPE_CHECKING:  # pragma: no cover
 
 _PREFIX = "k8s_llm_monitor"
 
+_METRIC_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABELS_RE = re.compile(
+    r'^\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\}$')
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+
 
 class _Writer:
-    def __init__(self) -> None:
+    def __init__(self, openmetrics: bool = False) -> None:
         self.lines: list[str] = []
+        self.openmetrics = openmetrics
 
     def metric(self, name: str, mtype: str, help_: str,
                samples: list[tuple[str, float]]) -> None:
@@ -44,8 +53,111 @@ class _Writer:
                 value = "NaN"
             self.lines.append(f"{full}{labels} {value}")
 
+    def histogram(self, name: str, help_: str, hist) -> None:
+        """Render an ``observability.metrics.ClassHistogram`` as one
+        Prometheus histogram family with a ``class`` label per SLO class.
+        In OpenMetrics mode each bucket with an exemplar gets the
+        ``# {trace_id="..."} value ts`` annotation — the dashboard's jump
+        from a bad latency bucket to the trace that landed in it."""
+        full = f"{_PREFIX}_{name}"
+        self.lines.append(f"# HELP {full} {help_}")
+        self.lines.append(f"# TYPE {full} histogram")
+        for cls in hist.classes():
+            cum, total, count, exemplars = hist.series(cls)
+            edges = [str(b) for b in hist.buckets] + ["+Inf"]
+            for i, (le, c) in enumerate(zip(edges, cum)):
+                line = f'{full}_bucket{{class="{cls}",le="{le}"}} {c}'
+                ex = exemplars.get(i) if self.openmetrics else None
+                if ex is not None:
+                    tid, value, ts = ex
+                    line += (f' # {{trace_id="{tid}"}} '
+                             f"{round(value, 6)} {round(ts, 3)}")
+                self.lines.append(line)
+            self.lines.append(
+                f'{full}_sum{{class="{cls}"}} {round(total, 6)}')
+            self.lines.append(f'{full}_count{{class="{cls}"}} {count}')
+
     def render(self) -> str:
-        return "\n".join(self.lines) + "\n"
+        body = "\n".join(self.lines) + "\n"
+        if self.openmetrics:
+            body += "# EOF\n"
+        return body
+
+
+def lint_exposition(text: str) -> list[str]:
+    """Validate Prometheus/OpenMetrics text exposition: every sample
+    belongs to a family with exactly one HELP and one TYPE, names and
+    label blocks are well-formed, values parse, and special markers use
+    the canonical spellings (``NaN``, ``+Inf``).  Returns human-readable
+    error strings; empty means clean.  Runs at render time (the exporter
+    appends its own error count as a metric) and in the tier-1 lint test.
+    """
+    errors: list[str] = []
+    helps: dict[str, int] = {}
+    types: dict[str, str] = {}
+    for n, raw in enumerate(text.splitlines(), start=1):
+        line = raw.rstrip()
+        if not line or line == "# EOF":
+            continue
+        if line.startswith("# HELP ") or line.startswith("# TYPE "):
+            kind = line[2:6]
+            parts = line.split(" ", 3)
+            if len(parts) < 4 or not parts[3].strip():
+                errors.append(f"line {n}: bare {kind} with no text")
+                continue
+            fam = parts[2]
+            if not _METRIC_NAME_RE.match(fam):
+                errors.append(f"line {n}: invalid family name {fam!r}")
+            if kind == "HELP":
+                helps[fam] = helps.get(fam, 0) + 1
+                if helps[fam] > 1:
+                    errors.append(f"line {n}: duplicate HELP for {fam}")
+            else:
+                if fam in types:
+                    errors.append(f"line {n}: duplicate TYPE for {fam}")
+                types[fam] = parts[3].strip()
+            continue
+        if line.startswith("#"):
+            continue  # free-form comment
+        # Sample line; OpenMetrics exemplars hang off " # ".
+        sample, _, exemplar = line.partition(" # ")
+        m = _SAMPLE_RE.match(sample.strip())
+        if m is None:
+            errors.append(f"line {n}: unparseable sample {line!r}")
+            continue
+        name, labels, value = m.groups()
+        fam = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if (name.endswith(suffix) and name[: -len(suffix)] in types
+                    and types[name[: -len(suffix)]] == "histogram"):
+                fam = name[: -len(suffix)]
+                break
+        if fam not in types:
+            errors.append(f"line {n}: sample {name} has no TYPE")
+        if fam not in helps:
+            errors.append(f"line {n}: sample {name} has no HELP")
+        if labels and not _LABELS_RE.match(labels):
+            errors.append(f"line {n}: malformed labels {labels!r}")
+        if value in ("nan", "inf", "-inf", "+inf", "Inf"):
+            errors.append(
+                f"line {n}: non-canonical marker {value!r} "
+                "(use NaN/+Inf/-Inf)")
+        else:
+            try:
+                float(value)
+            except ValueError:
+                errors.append(f"line {n}: bad value {value!r}")
+        if exemplar:
+            ex = exemplar.strip()
+            if not ex.startswith("{") or "}" not in ex:
+                errors.append(f"line {n}: malformed exemplar {ex!r}")
+    for fam in types:
+        if fam not in helps:
+            errors.append(f"family {fam}: TYPE without HELP")
+    for fam in helps:
+        if fam not in types:
+            errors.append(f"family {fam}: HELP without TYPE")
+    return errors
 
 
 def _engine_metrics(w: _Writer, engine) -> None:
@@ -195,6 +307,31 @@ def _engine_metrics(w: _Writer, engine) -> None:
              "Time to first token per request", samples)
     w.lines.append(f"{_PREFIX}_engine_ttft_seconds_sum {engine.ttft_sum}")
     w.lines.append(f"{_PREFIX}_engine_ttft_seconds_count {engine.ttft_count}")
+
+
+def _latency_histograms(w: _Writer, engine) -> None:
+    """Per-SLO-class latency histograms (observability.metrics), with
+    trace-id exemplars in OpenMetrics mode.  Families appear once a class
+    has at least one observation; absent class labels mean "no traffic of
+    that class yet", matching the per-class EMA NaN convention above."""
+    hists = (
+        ("request_ttft_seconds",
+         "Time to first token per request, by SLO class",
+         getattr(engine, "hist_ttft", None)),
+        ("request_e2e_seconds",
+         "Submit-to-final-token latency per request, by SLO class",
+         getattr(engine, "hist_e2e", None)),
+        ("request_queue_wait_seconds",
+         "Queue wait before admission per request, by SLO class",
+         getattr(engine, "hist_queue_wait", None)),
+        ("decode_step_seconds",
+         "Per-token decode segment time (segment wall time / steps), "
+         "by SLO class",
+         getattr(engine, "hist_decode_step", None)),
+    )
+    for name, help_, hist in hists:
+        if hist is not None:
+            w.histogram(name, help_, hist)
 
 
 _HEALTH_STATES = ("healthy", "degraded", "draining", "unhealthy")
@@ -464,8 +601,29 @@ def _device_metrics(w: _Writer) -> None:
              [("", len(devices))])
 
 
-def render_prometheus(srv: "MonitorServer") -> str:
-    w = _Writer()
+def _tracing_metrics(w: _Writer) -> None:
+    """Tracer + flight-recorder self-accounting."""
+    from k8s_llm_monitor_tpu.observability.flight import get_flight_recorder
+    from k8s_llm_monitor_tpu.observability.tracing import get_tracer
+
+    tracer = get_tracer()
+    w.metric("trace_sample_rate", "gauge",
+             "Configured head-sampling rate (K8SLLM_TRACE_SAMPLE)",
+             [("", tracer.sample)])
+    w.metric("trace_spans_recorded_total", "counter",
+             "Spans pushed to the in-process ring",
+             [("", tracer.recorded)])
+    rec = get_flight_recorder()
+    w.metric("flight_dumps_total", "counter",
+             "Flight-recorder artifacts written on failure edges",
+             [("", rec.dumps)])
+    w.metric("flight_dump_errors_total", "counter",
+             "Flight-recorder dump attempts that hit an OSError",
+             [("", rec.dump_errors)])
+
+
+def render_prometheus(srv: "MonitorServer", openmetrics: bool = False) -> str:
+    w = _Writer(openmetrics=openmetrics)
     w.metric("build_info", "gauge", "Monitor build info",
              [('{version="1.0.0"}', 1)])
     engine = None
@@ -476,6 +634,7 @@ def render_prometheus(srv: "MonitorServer") -> str:
         service = getattr(backend, "service", None)
     if engine is not None:
         _engine_metrics(w, engine)
+        _latency_histograms(w, engine)
         _resilience_metrics(w, engine, service)
     supervisor = srv.engine_supervisor() if hasattr(
         srv, "engine_supervisor") else None
@@ -493,5 +652,20 @@ def render_prometheus(srv: "MonitorServer") -> str:
     pipeline = getattr(srv, "diagnosis", None)
     if pipeline is not None or backend is not None:
         _diagnosis_metrics(w, pipeline, backend)
+    _tracing_metrics(w)
     _device_metrics(w)
+    # Render-time self-lint: a malformed family poisons the whole scrape
+    # silently (Prometheus drops what it can't parse), so the exporter
+    # counts its own format errors as a scrapeable metric.  The lint
+    # family is appended after linting; it uses the same writer path that
+    # every linted family went through.
+    errors = lint_exposition("\n".join(w.lines) + "\n")
+    if errors:  # pragma: no cover — a clean exporter never logs here
+        import logging
+
+        logging.getLogger("monitor.exporter").warning(
+            "exposition lint: %s", "; ".join(errors[:5]))
+    w.metric("exposition_lint_errors", "gauge",
+             "Format errors the exporter found in its own output "
+             "(0 = clean scrape)", [("", len(errors))])
     return w.render()

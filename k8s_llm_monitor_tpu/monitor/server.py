@@ -40,6 +40,10 @@ from k8s_llm_monitor_tpu.monitor.models import (
     utcnow,
 )
 from k8s_llm_monitor_tpu.monitor.network import NetworkAnalyzer
+from k8s_llm_monitor_tpu.observability.tracing import (
+    get_tracer,
+    parse_traceparent,
+)
 from k8s_llm_monitor_tpu.resilience.errors import OverloadedError
 from k8s_llm_monitor_tpu.resilience.slo import normalize_slo_class
 from k8s_llm_monitor_tpu.serving.kv_tier import BlobError
@@ -266,6 +270,7 @@ _ROUTES: dict[tuple[str, str], str] = {
     ("POST", "/api/v1/analyze"): "h_analyze",
     ("POST", "/api/v1/query"): "h_query",
     ("GET", "/api/v1/diagnoses"): "h_diagnoses",
+    ("GET", "/api/v1/trace"): "h_trace_recent",
     ("GET", "/api/v1/metrics/cluster"): "h_metrics_cluster",
     ("GET", "/api/v1/metrics/nodes"): "h_metrics_nodes",
     ("GET", "/api/v1/metrics/pods"): "h_metrics_pods",
@@ -319,6 +324,9 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
                     "queue_depth": exc.queue_depth,
                     "queue_tokens": exc.queue_tokens,
                     "slo_class": exc.slo_class,
+                    # Assigned before the refusal: lets clients join the
+                    # 429/503 with traces, logs, and the journal.
+                    "request_id": exc.request_id,
                     "timestamp": _now(),
                 },
                 status=429 if exc.retriable else 503,
@@ -359,24 +367,20 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
             parsed = urlparse(self.path)
             path = parsed.path
             try:
-                handler_name = _ROUTES.get((method, path))
-                if handler_name is not None:
-                    return getattr(self, handler_name)()
-                # prefix routes with a path parameter
-                if path.startswith("/api/v1/metrics/nodes/"):
-                    if method != "GET":
-                        return self._send_error_text("Method not allowed", 405)
-                    return self.h_metrics_node(path[len("/api/v1/metrics/nodes/") :])
-                if path.startswith("/api/v1/metrics/uav/"):
-                    if method != "GET":
-                        return self._send_error_text("Method not allowed", 405)
-                    return self.h_metrics_uav_node(path[len("/api/v1/metrics/uav/") :])
-                if path in _ROUTE_PATHS:
-                    # registered path, wrong method (ref per-handler checks)
-                    return self._send_error_text("Method not allowed", 405)
-                if method == "GET":
-                    return self.h_static(path)
-                return self._send_error_text("404 page not found", 404)
+                # Incoming W3C traceparent joins this handler (and every
+                # downstream engine/replica call it makes) to the caller's
+                # trace.  Requests without one are not traced at the HTTP
+                # layer — generation paths start their own trace at
+                # admission, and probe/static traffic stays out of the
+                # ring.  A malformed header never fails the request.
+                parent = parse_traceparent(
+                    self.headers.get("traceparent") or "")
+                if parent is not None:
+                    with get_tracer().span(
+                            "http.server", parent=parent,
+                            attrs={"method": method, "path": path}):
+                        return self._dispatch(method, path)
+                return self._dispatch(method, path)
             except BrokenPipeError:
                 pass
             except OverloadedError as exc:
@@ -395,6 +399,30 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
                     self._send_error_text(f"Internal server error: {exc}", 500)
                 except Exception:  # noqa: BLE001
                     pass
+
+        def _dispatch(self, method: str, path: str) -> None:
+            handler_name = _ROUTES.get((method, path))
+            if handler_name is not None:
+                return getattr(self, handler_name)()
+            # prefix routes with a path parameter
+            if path.startswith("/api/v1/metrics/nodes/"):
+                if method != "GET":
+                    return self._send_error_text("Method not allowed", 405)
+                return self.h_metrics_node(path[len("/api/v1/metrics/nodes/") :])
+            if path.startswith("/api/v1/metrics/uav/"):
+                if method != "GET":
+                    return self._send_error_text("Method not allowed", 405)
+                return self.h_metrics_uav_node(path[len("/api/v1/metrics/uav/") :])
+            if path.startswith("/api/v1/trace/"):
+                if method != "GET":
+                    return self._send_error_text("Method not allowed", 405)
+                return self.h_trace(path[len("/api/v1/trace/") :])
+            if path in _ROUTE_PATHS:
+                # registered path, wrong method (ref per-handler checks)
+                return self._send_error_text("Method not allowed", 405)
+            if method == "GET":
+                return self.h_static(path)
+            return self._send_error_text("404 page not found", 404)
 
         # -- static web (ref cmd/server/main.go:101) ---------------------------
 
@@ -441,15 +469,82 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
         def h_prometheus(self) -> None:
             # Self-observability the reference never had (SURVEY §5.5):
             # engine/manager/device gauges in Prometheus text format.
+            # OpenMetrics is Accept-negotiated: that mode adds exemplars
+            # (trace ids on latency histogram buckets) and the EOF marker;
+            # the default stays plain 0.0.4 text, exemplar-free.
             from k8s_llm_monitor_tpu.monitor.exporter import render_prometheus
 
-            body = render_prometheus(srv).encode()
+            accept = self.headers.get("Accept") or ""
+            openmetrics = "application/openmetrics-text" in accept
+            body = render_prometheus(srv, openmetrics=openmetrics).encode()
             self.send_response(200)
             self.send_header(
-                "Content-Type", "text/plain; version=0.0.4; charset=utf-8")
+                "Content-Type",
+                "application/openmetrics-text; version=1.0.0; charset=utf-8"
+                if openmetrics else
+                "text/plain; version=0.0.4; charset=utf-8")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        def h_trace_recent(self) -> None:
+            """Most recent traces in the span ring (id, span count, root
+            span name) plus tracer counters — the entry point for picking
+            a trace id to fetch in full."""
+            query = parse_qs(urlparse(self.path).query)
+            try:
+                limit = int((query.get("limit", ["20"])[0]) or 20)
+            except ValueError:
+                return self._send_error_text("limit must be an integer", 400)
+            tracer = get_tracer()
+            self._send_json({
+                "status": "success",
+                "traces": tracer.recent(limit),
+                "sample_rate": tracer.sample,
+                "spans_recorded": tracer.recorded,
+                "timestamp": _now(),
+            })
+
+        def h_trace(self, ref: str) -> None:
+            """One trace by request id or 32-hex trace id.  On router
+            roles the local spans are merged with every registered
+            replica's ring (dedup by span id, ordered by wall-clock
+            start) so a hedged / failed-over request reads as ONE
+            timeline across processes."""
+            ref = ref.strip().rstrip("/")
+            if not ref:
+                return self._send_error_text(
+                    "trace or request id is required", 400)
+            tracer = get_tracer()
+            trace_id = tracer.lookup(ref)
+            if trace_id is None:
+                return self._send_error_text(
+                    f"unknown trace or request id: {ref}", 404)
+            spans = tracer.spans_for(trace_id)
+            sources = ["local"]
+            router = srv.fleet_router()
+            if router is not None:
+                seen = {s["span_id"] for s in spans}
+                for rid, replica in router.replicas():
+                    try:
+                        remote = replica.fetch_trace(trace_id)
+                    except Exception:  # noqa: BLE001 — merge best-effort
+                        continue
+                    fresh = [s for s in remote
+                             if s.get("span_id") not in seen]
+                    if fresh:
+                        seen.update(s["span_id"] for s in fresh)
+                        spans.extend(fresh)
+                        sources.append(rid)
+                spans.sort(key=lambda s: s.get("start_unix", 0.0))
+            self._send_json({
+                "status": "success",
+                "trace_id": trace_id,
+                "spans": spans,
+                "n_spans": len(spans),
+                "sources": sources,
+                "timestamp": _now(),
+            })
 
         def h_profile(self) -> None:
             """Capture a jax.profiler trace (debug mode only): body
@@ -478,7 +573,21 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
             jax.profiler.start_trace(trace_dir)
             _time.sleep(seconds)
             jax.profiler.stop_trace()
-            self._send_json({"trace_dir": trace_dir, "seconds": seconds})
+            payload: dict[str, Any] = {
+                "trace_dir": trace_dir, "seconds": seconds}
+            if body.get("decode_phases"):
+                # Refresh the per-phase decode cost split (and the
+                # engine_decode_* gauges + collective_share span
+                # attribute that ride on it) behind the same debug gate.
+                # Requires an idle engine; refusal is reported, not fatal.
+                try:
+                    payload["decode_phases"] = self._engine_call(
+                        lambda e: e.profile_decode_phases())
+                except LookupError:
+                    payload["decode_phases_error"] = "no local engine"
+                except Exception as exc:  # noqa: BLE001 — busy engine
+                    payload["decode_phases_error"] = str(exc)
+            self._send_json(payload)
 
         def h_cluster_status(self) -> None:
             if srv.client is None:

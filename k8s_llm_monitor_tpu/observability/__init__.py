@@ -1,0 +1,21 @@
+"""In-tree observability: request tracing, latency histograms, and the
+crash flight recorder (docs/observability.md).
+
+Zero external dependencies.  Everything here is host-side bookkeeping —
+nothing in this package may be called from inside a traced (jitted)
+program; spans are recorded at dispatch/reconcile time on the step
+thread or on HTTP handler threads (the same discipline as
+``GenerationRequest``: host-side scheduling metadata only, zero
+recompiles).
+"""
+
+from .tracing import (  # noqa: F401
+    Span,
+    TraceContext,
+    Tracer,
+    format_traceparent,
+    get_tracer,
+    parse_traceparent,
+)
+from .metrics import ClassHistogram  # noqa: F401
+from .flight import FlightRecorder, get_flight_recorder  # noqa: F401

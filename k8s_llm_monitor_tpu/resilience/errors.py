@@ -24,7 +24,8 @@ class OverloadedError(Exception):
 
     def __init__(self, reason: str, queue_depth: int = 0,
                  queue_tokens: int = 0, retriable: bool = True,
-                 retry_after_s: float = 1.0, slo_class: str = ""):
+                 retry_after_s: float = 1.0, slo_class: str = "",
+                 request_id: str = ""):
         super().__init__(
             f"overloaded: {reason} "
             f"(queue_depth={queue_depth}, queue_tokens={queue_tokens})")
@@ -37,3 +38,7 @@ class OverloadedError(Exception):
         # plumbing or the layer doesn't know): clients use it to pick the
         # per-class backoff lane, the HTTP layer echoes it in the 429 body.
         self.slo_class = slo_class
+        # Request id assigned before the shed decision, echoed in the
+        # 429/503 body so the refusal is joinable with traces and the
+        # journal ('' when the shedding layer has no id to give).
+        self.request_id = request_id

@@ -63,6 +63,9 @@ import numpy as np
 
 from k8s_llm_monitor_tpu.models import llama
 from k8s_llm_monitor_tpu.models.config import ModelConfig
+from k8s_llm_monitor_tpu.observability.flight import get_flight_recorder
+from k8s_llm_monitor_tpu.observability.metrics import ClassHistogram
+from k8s_llm_monitor_tpu.observability.tracing import get_tracer
 from k8s_llm_monitor_tpu.resilience.faults import FaultError, get_injector
 from k8s_llm_monitor_tpu.resilience.slo import DEFAULT_CLASS, SLO_RANK
 from k8s_llm_monitor_tpu.ops.sampling import (
@@ -137,6 +140,11 @@ class GenerationRequest:
     # Host-side scheduling metadata only — orders admission, shedding, and
     # eviction; never enters a traced program (zero recompiles).
     slo_class: str = DEFAULT_CLASS
+    # Trace context (observability/tracing.py TraceContext) captured at
+    # EngineService.submit; the engine records phase spans against it.
+    # Host-side metadata only, like slo_class — never enters a traced
+    # program (zero recompiles).  None when the request is untraced.
+    trace: Any = None
 
 
 @dataclasses.dataclass
@@ -323,6 +331,11 @@ class _Inflight:
     lanes: list[tuple]
     # chunk: every slot touched by the call (inflight_chunks decrement).
     touched: list = dataclasses.field(default_factory=list)
+    # Dispatch timestamp (monotonic) — phase spans cover dispatch ->
+    # reconcile; host-side bookkeeping only.
+    t0: float = 0.0
+    # Per-call span attributes (chunk bucket, spec round count, ...).
+    span_attrs: dict = dataclasses.field(default_factory=dict)
 
 
 class _StuckPayload:
@@ -695,6 +708,29 @@ class InferenceEngine:
         # by profile_decode_phases() from the measured step time and the
         # ring-all-reduce byte model; 0.0 off-mesh or before profiling.
         self.decode_collective_share = 0.0
+        # Request-lifecycle histograms (observability/metrics.py): per-SLO
+        # class, with exemplar trace ids, observed on the step thread only.
+        # The exporter renders these as real Prometheus histograms.
+        _lat = (0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
+        self.hist_ttft = ClassHistogram(_lat)
+        self.hist_e2e = ClassHistogram(_lat)
+        self.hist_queue_wait = ClassHistogram(_lat)
+        # Per fused-decode-step seconds (call wall time / steps in call).
+        self.hist_decode_step = ClassHistogram(
+            (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 1.0))
+        # Tracing (observability/tracing.py): phase spans are recorded
+        # host-side at dispatch/reconcile time against each request's
+        # captured TraceContext — never inside a traced program.  Engine
+        # maintenance work with no owning request (KV spill/restore)
+        # records under a per-engine synthetic root span.
+        self._tracer = get_tracer()
+        self._flight = get_flight_recorder()
+        self._maint_ctx = self._tracer.new_trace()
+        if self._maint_ctx is not None and self._maint_ctx.sampled:
+            t_now = time.monotonic()
+            self._tracer.record(
+                "engine.maintenance", t_now, t_now, self._maint_ctx,
+                span_id=self._maint_ctx.span_id, parent_id="")
 
     # ------------------------------------------------------------------
     # public API
@@ -975,6 +1011,8 @@ class InferenceEngine:
     def _record_dispatch_failure(self, exc: BaseException) -> None:
         self.dispatch_failures += 1
         self.consecutive_dispatch_failures += 1
+        self._flight.note("dispatch_failure", error=repr(exc)[:200],
+                          consecutive=self.consecutive_dispatch_failures)
         if self.health is not None:
             self.health.record_dispatch_failure()
 
@@ -987,7 +1025,8 @@ class InferenceEngine:
         """Track how long requests sit queued before winning a slot — the
         EMA backs the ``shed_slot_wait_s`` load-shedding signal; the
         per-class EMAs back the exporter's ``queue_wait_ms{class}``."""
-        wait = time.monotonic() - req.submit_time
+        now = time.monotonic()
+        wait = now - req.submit_time
         if self.slot_wait_ema_s == 0.0:
             self.slot_wait_ema_s = wait
         else:
@@ -996,6 +1035,43 @@ class InferenceEngine:
         prev = self.slot_wait_ema_by_class.get(req.slo_class)
         self.slot_wait_ema_by_class[req.slo_class] = (
             wait if prev is None else 0.9 * prev + 0.1 * wait)
+        self.hist_queue_wait.observe(wait, req.slo_class, self._trace_id(req))
+        self._span("engine.queue_wait", req.submit_time, now, req)
+
+    # -- tracing helpers (observability/tracing.py) ----------------------
+
+    @staticmethod
+    def _trace_id(req: GenerationRequest) -> str:
+        """Exemplar trace id for histograms ('' when untraced/unsampled)."""
+        ctx = req.trace
+        return ctx.trace_id if ctx is not None and ctx.sampled else ""
+
+    def _span(self, name: str, t0: float, t1: float,
+              req: GenerationRequest, status: str = "ok", **attrs) -> None:
+        """Record one engine phase span under ``req``'s trace.  No-op for
+        untraced or unsampled requests — the hot-path cost is one
+        attribute check."""
+        ctx = req.trace
+        if ctx is None or not ctx.sampled:
+            return
+        attrs["request_id"] = req.request_id
+        attrs["class"] = req.slo_class
+        self._tracer.record(name, t0, t1, ctx, attrs=attrs, status=status)
+
+    def _end_request_span(self, req: GenerationRequest, status: str,
+                          **attrs) -> None:
+        """Close the per-request root span (submit -> terminal outcome).
+        Uses the context's own span/parent ids so the phase spans recorded
+        along the way nest under it with no orphan parents."""
+        ctx = req.trace
+        if ctx is None or not ctx.sampled:
+            return
+        attrs["request_id"] = req.request_id
+        attrs["class"] = req.slo_class
+        self._tracer.record(
+            "engine.request", req.submit_time, time.monotonic(), ctx,
+            span_id=ctx.span_id, parent_id=ctx.parent_id,
+            attrs=attrs, status=status)
 
     # -- SLO-class scheduling (resilience/slo.py) ------------------------
 
@@ -1132,6 +1208,11 @@ class InferenceEngine:
                 max_tokens=max(1, req.sampling.max_tokens - consumed))
         self._cap_request(req)
         self._pending.appendleft(req)
+        t_now = time.monotonic()
+        self._span("engine.requeue", t_now, t_now, req, status="error",
+                   cause=cause[:200], requeues=req.requeues)
+        self._flight.note("requeue", request_id=req.request_id,
+                          cause=cause, requeues=req.requeues)
 
     def _reset_pipeline(self, cause: str,
                         extra_calls: tuple = ()) -> None:
@@ -1145,6 +1226,13 @@ class InferenceEngine:
         by ``max_requeues``).  Shared prefix pages are dropped for the
         same reason.  The allocator's free count returns to its idle
         baseline — nothing leaks across a reset."""
+        # Failure edge: snapshot the span ring + recent events to a flight
+        # artifact BEFORE recovery mutates slot state (watchdog fires land
+        # here), so the postmortem shows the pipeline as it wedged.
+        self._flight.note("pipeline_reset", cause=cause,
+                          inflight=len(self._inflight) + len(extra_calls),
+                          watchdog_trips=self.watchdog_trips)
+        self._flight.dump("pipeline_reset", extra={"cause": cause})
         calls = list(extra_calls) + list(self._inflight)
         self._inflight.clear()
         for call in calls:
@@ -1222,6 +1310,10 @@ class InferenceEngine:
             error=msg,
         )
         self._results[req.request_id] = result
+        self.hist_e2e.observe(result.latency_s, req.slo_class,
+                              self._trace_id(req))
+        self._end_request_span(req, "error", finish_reason="error",
+                               error=msg[:200])
         if self.token_sink is not None:
             self.token_sink(req.request_id, [], result)
 
@@ -1309,11 +1401,21 @@ class InferenceEngine:
             peek = pc.peek_lru()
             if peek is not None:
                 digest, blocks = peek
+                t_spill = time.monotonic()
                 try:
                     tier.put(digest, self._fetch_rows(blocks))
                 except Exception as exc:  # noqa: BLE001 — spill must never block eviction
                     logger.warning("KV spill failed (%s); dropping entry",
                                    exc)
+                else:
+                    # Cache-maintenance work has no owning request; spans
+                    # land under the engine's synthetic maintenance root.
+                    if (self._maint_ctx is not None
+                            and self._maint_ctx.sampled):
+                        self._tracer.record(
+                            "engine.kv_spill", t_spill, time.monotonic(),
+                            self._maint_ctx, attrs={"blocks": len(blocks)})
+                    self._flight.note("kv_spill", blocks=len(blocks))
         return pc.evict_lru()
 
     def _fetch_rows(self, blocks: list[int]) -> SpilledPrefix:
@@ -1659,8 +1761,17 @@ class InferenceEngine:
                     # here, overlapped with the rest of admission prep —
                     # the scatter is async; the prefill that consumes the
                     # pages queues behind it on the dispatch chain.
+                    t_res = time.monotonic()
+                    pre_toks = shared_toks
                     shared, shared_toks = self._try_restore(
                         req.prompt_ids, shared, shared_toks)
+                    if shared_toks > pre_toks:
+                        self._span("engine.kv_restore", t_res,
+                                   time.monotonic(), req,
+                                   tokens=shared_toks - pre_toks)
+                        self._flight.note(
+                            "kv_restore", request_id=req.request_id,
+                            tokens=shared_toks - pre_toks)
                 suffix = L - shared_toks
 
                 def worth(gain: int) -> bool:
@@ -1851,7 +1962,9 @@ class InferenceEngine:
             for slot_idx, req, blocks, st in batch:
                 self.prefix_cache.register(req.prompt_ids, blocks)
         self._finish_admit_dispatch(
-            first, [(s, r, b) for s, r, b, _ in batch], idx, fsm_next=fnext)
+            first, [(s, r, b) for s, r, b, _ in batch], idx, fsm_next=fnext,
+            span_attrs={"bucket": bucket, "lanes": len(batch),
+                        "shared": any_shared})
         return True
 
     def _dispatch_prefill_chunks(self) -> bool:
@@ -1984,11 +2097,13 @@ class InferenceEngine:
             self.prefix_cache.register(s.req.prompt_ids, s.blocks)
         self.prefills += len(lanes)
         self._queue_inflight("chunk", first, idx, lanes, touched,
-                             fsm_next=fnext)
+                             fsm_next=fnext,
+                             span_attrs={"bucket": bucket,
+                                         "lanes": len(cands)})
         return True
 
     def _queue_inflight(self, kind: str, first, idx, lanes,
-                        touched=(), fsm_next=None) -> None:
+                        touched=(), fsm_next=None, span_attrs=None) -> None:
         """Shared dispatch tail: place sampled tokens into the device token
         buffer, start the async host copy, and queue the reconcile entry."""
         self._tok_state = self._place_tokens(
@@ -2010,11 +2125,12 @@ class InferenceEngine:
             pass
         self._inflight.append(_Inflight(
             kind=kind, call_id=self._next_call_id, arr=first,
-            lanes=list(lanes), touched=list(touched)))
+            lanes=list(lanes), touched=list(touched),
+            t0=time.monotonic(), span_attrs=span_attrs or {}))
         self._next_call_id += 1
 
     def _finish_admit_dispatch(self, first, batch, idx,
-                               fsm_next=None) -> None:
+                               fsm_next=None, span_attrs=None) -> None:
         """Admission tail: occupy slots, then queue via the shared path."""
         lanes = []
         for slot_idx, req, blocks in batch:
@@ -2024,7 +2140,8 @@ class InferenceEngine:
             lanes.append((slot_idx, req))
         self.prefills += len(batch)
         self._write_hist(lanes)
-        self._queue_inflight("admit", first, idx, lanes, fsm_next=fsm_next)
+        self._queue_inflight("admit", first, idx, lanes, fsm_next=fsm_next,
+                             span_attrs=span_attrs)
 
     # -- decode ---------------------------------------------------------
 
@@ -2552,7 +2669,10 @@ class InferenceEngine:
         if self._faults.should_fire("decode_stuck"):
             payload = _StuckPayload(payload)
         self._inflight.append(_Inflight(
-            kind=kind, call_id=self._next_call_id, arr=payload, lanes=meta))
+            kind=kind, call_id=self._next_call_id, arr=payload, lanes=meta,
+            t0=time.monotonic(),
+            span_attrs={"steps": K, "lanes": len(lanes),
+                        "constrained": constrained}))
         self._next_call_id += 1
         return True
 
@@ -2721,6 +2841,8 @@ class InferenceEngine:
             rows = (enumerate(call.lanes) if call.kind == "admit"
                     else ((row, (slot_idx, req))
                           for row, slot_idx, req in call.lanes))
+            span_name = ("engine.prefill" if call.kind == "admit"
+                         else "engine.prefill_chunk")
             for j, (slot_idx, req) in rows:
                 s = self._slots[slot_idx]
                 if s is None or s.req is not req:
@@ -2730,12 +2852,22 @@ class InferenceEngine:
                 s.generated.append(tok)
                 if req.first_token_time == 0.0:
                     req.first_token_time = now
-                    self._observe_ttft(now - req.submit_time, req.slo_class)
+                    self._observe_ttft(now - req.submit_time, req.slo_class,
+                                       trace_id=self._trace_id(req))
                 s.first_token_time = req.first_token_time
+                self._span(span_name, call.t0, now, req,
+                           constrained=req.sampling.constrained,
+                           **call.span_attrs)
                 self._emit(req, [tok])
                 if self._is_finished(s) or s.cancel_requested:
                     self._retire(slot_idx)
         else:
+            now = time.monotonic()
+            span_name = ("engine.spec_decode" if call.kind == "spec"
+                         else "engine.decode")
+            # Satellite: the analytic collective share from the last
+            # profile_decode_phases() run rides on every decode segment.
+            coll = self.decode_collective_share
             for slot_idx, s, steps_i in call.lanes:
                 if self._slots[slot_idx] is not s or s.retired:
                     continue  # lane EOSed in an earlier call; discard zombies
@@ -2743,6 +2875,16 @@ class InferenceEngine:
                 s.inflight_decode -= steps_i
                 if call.kind == "spec":
                     self.spec_tokens += len(new)
+                if steps_i > 0:
+                    self.hist_decode_step.observe(
+                        max(0.0, now - call.t0) / steps_i,
+                        s.req.slo_class, self._trace_id(s.req))
+                attrs = {"steps": steps_i, "emitted": len(new)}
+                if coll > 0.0:
+                    attrs["collective_share"] = coll
+                if call.kind == "spec":
+                    attrs["rounds"] = self.ecfg.spec_rounds_per_iter
+                self._span(span_name, call.t0, now, s.req, **attrs)
                 if not new:
                     continue
                 s.ctx_len += len(new)
@@ -2753,7 +2895,9 @@ class InferenceEngine:
                     self._retire(slot_idx)
 
     def _observe_ttft(self, ttft_s: float,
-                      slo_class: str = DEFAULT_CLASS) -> None:
+                      slo_class: str = DEFAULT_CLASS,
+                      trace_id: str = "") -> None:
+        self.hist_ttft.observe(ttft_s, slo_class, trace_id)
         for i, le in enumerate(self.ttft_buckets):
             if ttft_s <= le:
                 self.ttft_counts[i] += 1
@@ -2796,6 +2940,12 @@ class InferenceEngine:
             latency_s=now - s.req.submit_time,
         )
         self._results[s.req.request_id] = result
+        self.hist_e2e.observe(result.latency_s, s.req.slo_class,
+                              self._trace_id(s.req))
+        self._end_request_span(
+            s.req, "error" if reason == "error" else "ok",
+            finish_reason=reason, tokens=len(toks),
+            ttft_s=round(result.ttft_s, 6))
         if self.token_sink is not None:
             self.token_sink(s.req.request_id, [], result)
         if self._inflight:
@@ -2831,3 +2981,8 @@ class InferenceEngine:
         self.preemptions += 1
         self.preemptions_by_class[req.slo_class] = (
             self.preemptions_by_class.get(req.slo_class, 0) + 1)
+        t_now = time.monotonic()
+        self._span("engine.preempt", t_now, t_now, req,
+                   tokens_folded=consumed)
+        self._flight.note("preempt", request_id=req.request_id,
+                          slo_class=req.slo_class, tokens_folded=consumed)

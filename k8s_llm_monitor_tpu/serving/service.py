@@ -25,6 +25,8 @@ import time
 from typing import Callable, Iterator, Optional
 
 from k8s_llm_monitor_tpu.devtools.lockcheck import guarded_by, make_lock
+from k8s_llm_monitor_tpu.observability.flight import get_flight_recorder
+from k8s_llm_monitor_tpu.observability.tracing import Tracer, get_tracer
 from k8s_llm_monitor_tpu.resilience.errors import OverloadedError
 from k8s_llm_monitor_tpu.resilience.faults import get_injector
 from k8s_llm_monitor_tpu.resilience.health import HealthMonitor
@@ -215,11 +217,15 @@ class EngineService:
 
     # -- submission -----------------------------------------------------
 
-    def _record_shed(self, slo_class: str = DEFAULT_CLASS) -> float:
+    def _record_shed(self, slo_class: str = DEFAULT_CLASS,
+                     request_id: str = "", reason: str = "",
+                     trace_ctx=None) -> float:
         """Bump shed counters; returns a Retry-After hint that backs off
         with consecutive sheds *of this class* (reset by the class's next
         successful admit) — overloaded batch lanes escalate their hint
-        without inflating the interactive lane's."""
+        without inflating the interactive lane's.  Also records the shed
+        decision as an instant span and a flight-recorder event so a
+        refusal shows up in the request's timeline."""
         with self._handles_lock:
             self.shed_count += 1
             self.shed_count_by_class[slo_class] = (
@@ -228,6 +234,14 @@ class EngineService:
                 self._shed_streaks.get(slo_class, 0) + 1)
             streak = self._shed_streaks[slo_class]
         self.health.record_shed()
+        now = time.monotonic()
+        get_tracer().record(
+            "service.shed", now, now, trace_ctx, status="error",
+            attrs={"request_id": request_id, "class": slo_class,
+                   "reason": reason})
+        get_flight_recorder().note(
+            "shed", request_id=request_id, slo_class=slo_class,
+            reason=reason)
         return self._shed_backoff.delay(min(streak - 1, 4))
 
     def submit(
@@ -250,6 +264,19 @@ class EngineService:
         admission, shedding, and eviction (resilience/slo.py).
         """
         slo_class = normalize_slo_class(slo_class)
+        # The id exists BEFORE any shed decision so every 429/503 body
+        # carries it — a refused request is joinable with traces and
+        # journal records even though it never reached the engine.
+        if request_id is None:
+            request_id = f"svc-{next(self._ids)}"
+        # Trace context: join the caller's trace (HTTP handler thread set
+        # it from ``traceparent``) or start a fresh one; the request's own
+        # span is a child so engine phase spans nest under it.  None when
+        # sampling is fully off — the engine then skips all span work.
+        tracer = get_tracer()
+        parent_ctx = tracer.current() or tracer.new_trace()
+        trace_ctx = Tracer.child(parent_ctx) if parent_ctx is not None else None
+        tracer.bind(request_id, trace_ctx)
         with self._handles_lock:
             dead = self._dead
             draining = self._draining
@@ -259,30 +286,36 @@ class EngineService:
             if draining or self._stop.is_set():
                 # Not retriable *here* — this replica is going away; the
                 # client should retry against another replica.
-                hint = self._record_shed(slo_class)
+                hint = self._record_shed(slo_class, request_id, "draining",
+                                         trace_ctx)
                 raise OverloadedError("draining", retriable=False,
                                       retry_after_s=hint,
-                                      slo_class=slo_class)
+                                      slo_class=slo_class,
+                                      request_id=request_id)
             reason = self.engine.should_shed(slo_class)
             if reason:
-                hint = self._record_shed(slo_class)
+                hint = self._record_shed(slo_class, request_id, reason,
+                                         trace_ctx)
                 raise OverloadedError(
                     reason,
                     queue_depth=self.engine.queue_depth,
                     queue_tokens=self.engine.queue_tokens,
                     retry_after_s=hint,
-                    slo_class=slo_class)
+                    slo_class=slo_class,
+                    request_id=request_id)
         self.health.record_admit()
         with self._handles_lock:
             self._shed_streaks.pop(slo_class, None)
-        if request_id is None:
-            request_id = f"svc-{next(self._ids)}"
         if handle is None:
             handle = RequestHandle(request_id, self.engine.eos_id,
                                    cancel_fn=self._request_cancel)
         else:
             handle._eos_id = self.engine.eos_id
             handle._cancel_fn = self._request_cancel
+        # Kept on the handle so _fail_all can close the request span when
+        # the engine dies before retiring it (no orphan parents in the
+        # trace even across a replica kill).
+        handle.trace = trace_ctx
         with self._handles_lock:
             self._handles[request_id] = handle
         self._submissions.put(GenerationRequest(
@@ -291,6 +324,7 @@ class EngineService:
             sampling=sampling or SamplingParams(),
             deadline_s=deadline_s,
             slo_class=slo_class,
+            trace=trace_ctx,
         ))
         self._wake.set()
         return handle
@@ -493,6 +527,14 @@ class EngineService:
                 pass
 
     def _fail_all(self, msg: str) -> None:
+        # Failure edge: dump the flight recorder (span ring + recent
+        # engine events) so the mass-failure has a postmortem timeline.
+        # A clean stop with nothing in flight is not a failure — skip the
+        # artifact so routine shutdowns don't litter the flight dir.
+        with self._handles_lock:
+            had_work = bool(self._handles)
+        if had_work or not self._submissions.empty():
+            get_flight_recorder().dump("fail_all", extra={"msg": msg})
         # Drain submissions that raced the death of the loop so their
         # handles fail instead of hanging until timeout.
         while True:
@@ -503,7 +545,18 @@ class EngineService:
         with self._handles_lock:
             handles = list(self._handles.values())
             self._handles.clear()
+        now = time.monotonic()
         for h in handles:
+            # The engine died before retiring this request, so its
+            # "engine.request" span (the parent of any phase spans already
+            # recorded) would never be emitted — close it here so the
+            # trace has no orphan parents.
+            ctx = getattr(h, "trace", None)
+            if ctx is not None:
+                get_tracer().record(
+                    "engine.request", now, now, ctx, status="error",
+                    span_id=ctx.span_id, parent_id=ctx.parent_id,
+                    attrs={"request_id": h.request_id, "error": msg[:200]})
             h._push([], GenerationResult(
                 request_id=h.request_id, token_ids=[], finish_reason="error",
                 ttft_s=0.0, latency_s=0.0, error=msg,

@@ -48,6 +48,8 @@ from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from k8s_llm_monitor_tpu.devtools.lockcheck import guarded_by, make_lock
+from k8s_llm_monitor_tpu.observability.flight import get_flight_recorder
+from k8s_llm_monitor_tpu.observability.tracing import get_tracer
 from k8s_llm_monitor_tpu.resilience.errors import OverloadedError
 from k8s_llm_monitor_tpu.resilience.health import HealthMonitor
 from k8s_llm_monitor_tpu.resilience.journal import (
@@ -204,19 +206,23 @@ class EngineSupervisor:
         slo_class: str = DEFAULT_CLASS,
     ) -> RequestHandle:
         """Journal (write-ahead), track, and admit one request."""
+        if request_id is None:
+            # Unique across process restarts sharing one journal dir.
+            # Assigned BEFORE any refusal so every 429/503 body carries
+            # the id (joinable with traces and journal records).
+            request_id = f"req-{self._pid}-{next(self._ids)}"
         with self._lock:
             state = self._state
         if state == REBUILDING:
             raise OverloadedError(
                 "engine rebuilding", retriable=True,
-                retry_after_s=self.backoff.delay(0) + 0.5)
+                retry_after_s=self.backoff.delay(0) + 0.5,
+                slo_class=slo_class, request_id=request_id)
         if state != SERVING:
             raise OverloadedError(f"lifecycle state {state}",
-                                  retriable=False)
+                                  retriable=False, slo_class=slo_class,
+                                  request_id=request_id)
         sampling = sampling or SamplingParams()
-        if request_id is None:
-            # Unique across process restarts sharing one journal dir.
-            request_id = f"req-{self._pid}-{next(self._ids)}"
         tracked = _Tracked(list(prompt_ids), sampling, deadline_s,
                            time.time(), slo_class=slo_class)
         # Track before the engine can emit a single token for this id, and
@@ -242,7 +248,8 @@ class EngineSupervisor:
                 # a rebuild is imminent — tell the client to retry.
                 raise OverloadedError(
                     "engine restarting", retriable=True,
-                    retry_after_s=self.backoff.delay(0) + 0.5) from exc
+                    retry_after_s=self.backoff.delay(0) + 0.5,
+                    slo_class=slo_class, request_id=request_id) from exc
             raise
         tracked.handle = handle
         return handle
@@ -327,12 +334,30 @@ class EngineSupervisor:
             attempt = self.restarts
         logger.warning("engine restart %d/%d: %s",
                        attempt, self.max_restarts, reason)
+        # Dump the flight artifact before recovery mutates state: the span
+        # ring and event log still describe the failing incarnation.
+        rec = get_flight_recorder()
+        rec.note("supervisor_rebuild", reason=reason, attempt=attempt)
+        rec.dump("supervisor_rebuild",
+                 extra={"reason": reason, "attempt": attempt})
         old = self.service
         handles = old.detach_handles()
         # A wedged loop may wake up long after the rebuild: its late tokens
         # are from a replaced engine incarnation and must not reach the
         # tracked state (they would duplicate what the new engine re-emits).
         old.observer = None
+        # Close the dying incarnation's request spans: phase spans already
+        # recorded parent them, and replay mints fresh contexts — without
+        # this the old parents would never be emitted (orphan spans).
+        tracer = get_tracer()
+        t_now = time.monotonic()
+        for rid, h in handles.items():
+            ctx = getattr(h, "trace", None)
+            if ctx is not None:
+                tracer.record(
+                    "engine.request", t_now, t_now, ctx, status="error",
+                    span_id=ctx.span_id, parent_id=ctx.parent_id,
+                    attrs={"request_id": rid, "outcome": "rebuild"})
         if attempt > self.max_restarts:
             self._give_up(f"restart budget exhausted after: {reason}",
                           handles)
@@ -419,6 +444,7 @@ class EngineSupervisor:
 
     def _give_up(self, reason: str, handles: dict[str, RequestHandle]) -> None:
         logger.error("supervisor giving up: %s", reason)
+        get_flight_recorder().note("supervisor_give_up", reason=reason)
         with self._lock:
             self._state = FAILED
             pending = list(self._tracked.items())

@@ -194,3 +194,24 @@ def test_profile_endpoint_gated_by_debug(engine_server):
     with pytest.raises(urllib.error.HTTPError) as exc:
         urllib.request.urlopen(req, timeout=30)
     assert exc.value.code == 403
+
+
+def test_live_metrics_pass_self_lint(engine_server):
+    """The real exporter output — both Prometheus text and OpenMetrics —
+    must pass the in-tree exposition linter, and the render-time check
+    must report zero errors for itself."""
+    from k8s_llm_monitor_tpu.monitor.exporter import lint_exposition
+
+    srv, _ = engine_server
+    text = _metrics_text(srv.port)
+    assert lint_exposition(text) == []
+    assert "k8s_llm_monitor_exposition_lint_errors 0" in text
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/metrics",
+        headers={"Accept": "application/openmetrics-text"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.headers["Content-Type"].startswith(
+            "application/openmetrics-text")
+        om = r.read().decode()
+    assert lint_exposition(om) == []
