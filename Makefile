@@ -50,8 +50,8 @@ tier1:              # the driver's verify gate, verbatim (ROADMAP.md)
 tier1-mesh:
 	$(TEST_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	  K8SLLM_LOCKCHECK=1 \
-	  $(PY) -m pytest tests/test_sharding.py tests/test_spec_decode.py -q \
-	  -p no:cacheprovider
+	  $(PY) -m pytest tests/test_sharding.py tests/test_spec_decode.py \
+	  tests/test_overlap.py -q -p no:cacheprovider
 
 chaos:              # fault-injection resilience suite (docs/resilience.md)
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m chaos -p no:cacheprovider

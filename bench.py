@@ -574,6 +574,207 @@ def mesh_leg(cfg, params) -> dict:
     }
 
 
+def overlap_leg(cfg, params) -> dict:
+    """Latency-hiding TP decode (parallel/overlap.py): overlap-on vs
+    overlap-off engines on the same mesh, per-step decode time for each,
+    and the resulting ``decode_collective_hidden_share`` — measured
+    against the ring byte model on TPU, the analytic weight-streaming
+    window in the CPU dryrun (engine.estimate_hidden_share).  A small
+    TTFT burst runs through the overlap-on engine so the mesh JSON also
+    carries end-to-end percentiles for the schedule that actually serves.
+
+    When the bench model cannot take the staged schedule on this device
+    count (e.g. the "tiny" preset's 2 KV heads under TP-8 — pages would
+    replicate), the leg substitutes a TP-aligned tiny stand-in and labels
+    it, so the dryrun still gates the schedule end to end.
+    """
+    import numpy as np
+    import jax
+
+    from k8s_llm_monitor_tpu.models import llama
+    from k8s_llm_monitor_tpu.parallel.mesh import MeshConfig, create_mesh
+    from k8s_llm_monitor_tpu.parallel.overlap import overlap_supported
+    from k8s_llm_monitor_tpu.serving.engine import (
+        EngineConfig,
+        GenerationRequest,
+        InferenceEngine,
+        SamplingParams,
+    )
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        raise RuntimeError("overlap leg needs >= 2 devices")
+    mesh = create_mesh(MeshConfig(model=len(devs)))
+    dryrun = devs[0].platform != "tpu"
+
+    why_not = overlap_supported(cfg, mesh)
+    model_name = cfg.name
+    if why_not:
+        import dataclasses
+
+        log(f"overlap leg: {cfg.name} unsupported ({why_not}); "
+            f"measuring a TP-aligned tiny stand-in")
+        cfg = dataclasses.replace(cfg, name="tiny-tp", num_heads=8,
+                                  num_kv_heads=8, num_experts=0,
+                                  sandwich_norms=False, qkv_bias=False)
+        params = llama.init_params(jax.random.PRNGKey(0), cfg)
+        model_name = cfg.name
+
+    o_len = int(os.environ.get("BENCH_MESH_PROMPT_LEN", "48"))
+    o_gen = int(os.environ.get("BENCH_MESH_MAX_TOKENS", "12"))
+    o_n = int(os.environ.get("BENCH_MESH_CONCURRENCY", "12"))
+    o_slots = int(os.environ.get("BENCH_MESH_SLOTS", "8"))
+    cap = o_len + o_gen + 1
+    ecfg_kw = dict(
+        max_slots=o_slots,
+        num_blocks=o_slots * ((cap + 15) // 16) + 16,
+        block_size=16,
+        max_blocks_per_seq=(cap + 15) // 16,
+        prefill_buckets=(int(np.ceil(o_len / 64) * 64),),
+        max_prefills_per_step=min(16, o_slots),
+        max_admission_rounds=8,
+        decode_steps_per_iter=int(os.environ.get("BENCH_DECODE_STEPS", "8")),
+    )
+    rng = np.random.default_rng(7)
+
+    def o_prompt() -> list[int]:
+        return [int(t) for t in
+                rng.integers(4, cfg.vocab_size - 4, size=o_len)]
+
+    def build(tp_overlap: str) -> InferenceEngine:
+        eng = InferenceEngine(cfg, params,
+                              EngineConfig(tp_overlap=tp_overlap, **ecfg_kw),
+                              eos_id=-1, mesh=mesh)
+        eng.generate([o_prompt() for _ in range(2)],
+                     SamplingParams(max_tokens=4))  # warm compiles
+        return eng
+
+    eng_off = build("off")
+    t_off = eng_off.profile_decode_phases()["decode_step_ms_short_ctx"]
+    del eng_off
+    eng_on = build("on")
+    assert eng_on.tp_overlap
+    t_on = eng_on.profile_decode_phases()["decode_step_ms_short_ctx"]
+    hidden = eng_on.estimate_hidden_share(step_ms_on=t_on,
+                                          step_ms_off=t_off)
+
+    t0 = time.monotonic()
+    for i in range(o_n):
+        eng_on.submit(GenerationRequest(
+            request_id=f"ov-{i}", prompt_ids=o_prompt(),
+            sampling=SamplingParams(max_tokens=o_gen)))
+    while eng_on.has_work:
+        eng_on.step()
+    wall = time.monotonic() - t0
+    res = [eng_on.poll(f"ov-{i}") for i in range(o_n)]
+    assert all(r is not None and r.finish_reason != "error" for r in res)
+    t = np.array(sorted(r.ttft_s for r in res))
+    p50_ms = float(np.percentile(t, 50)) * 1e3
+    p99_ms = float(np.percentile(t, 99)) * 1e3
+    tok_s = sum(len(r.token_ids) for r in res) / wall
+
+    log(f"overlap ({model_name}, {len(devs)} devices): decode step "
+        f"{t_on:.2f} ms on vs {t_off:.2f} ms off, hidden share "
+        f"{hidden:.0%}{' (analytic dryrun)' if dryrun else ''}; "
+        f"p50 TTFT {p50_ms:.1f} ms, p99 {p99_ms:.1f} ms, {tok_s:.1f} tok/s")
+    return {
+        "overlap_model": model_name,
+        "overlap_decode_step_ms_on": round(t_on, 3),
+        "overlap_decode_step_ms_off": round(t_off, 3),
+        "decode_collective_hidden_share": round(hidden, 4),
+        "overlap_hidden_share_analytic": dryrun,
+        "overlap_p50_ttft_ms": round(p50_ms, 2),
+        "overlap_p99_ttft_ms": round(p99_ms, 2),
+        "overlap_tok_s": round(tok_s, 1),
+    }
+
+
+def tier_admission_leg(cfg, params) -> dict:
+    """Tier-aware admission (engine.admission_headroom_tokens): at EQUAL
+    device pool bytes, an engine whose device blocks are pinned by
+    spillable prefix-cache content admits a burst under
+    ``kv_admission="tier"`` (the host tier can take the spill losslessly)
+    that ``kv_admission="device"`` sheds.  Every admitted lane must
+    finish clean with its full token budget while ``lane_eviction``
+    faults are armed — the zero-lost-tokens clause.
+    """
+    import numpy as np
+
+    from k8s_llm_monitor_tpu.resilience.faults import get_injector
+    from k8s_llm_monitor_tpu.serving.engine import (
+        EngineConfig,
+        GenerationRequest,
+        InferenceEngine,
+        SamplingParams,
+    )
+
+    bs = 16
+    seed_len = 64       # 4 full blocks each stay pinned in the prefix cache
+    a_len, a_gen = 120, 8
+    bps = (a_len + a_gen + 1 + bs - 1) // bs
+    n_burst = 6
+    rng = np.random.default_rng(23)
+
+    def a_prompt(n: int) -> list[int]:
+        return [int(t) for t in rng.integers(4, cfg.vocab_size - 4, size=n)]
+
+    # Pool sized so the seeds' cacheable blocks pin most of it: each seed
+    # publishes shareable_blocks(64,16)=3 blocks, 12 pinned of 17 usable.
+    # Device-only headroom after seeding is 5 blocks = 80 tokens < the
+    # 121 a burst lane needs; the tier policy counts the 12 evictable
+    # (host-spillable) blocks too and admits.
+    seed_prompts = [a_prompt(seed_len) for _ in range(4)]
+    num_blocks = 18
+
+    def run(kv_admission: str):
+        ecfg = EngineConfig(
+            max_slots=4, num_blocks=num_blocks, block_size=bs,
+            max_blocks_per_seq=bps, prefill_buckets=(64, 128),
+            max_prefills_per_step=2, decode_steps_per_iter=4,
+            prefix_cache_entries=64, host_spill_bytes=64 << 20,
+            kv_admission=kv_admission)
+        eng = InferenceEngine(cfg, params, ecfg, eos_id=-1)
+        # Fill the device pool with published (evictable) prefixes.
+        for p in seed_prompts:
+            eng.generate([p], SamplingParams(max_tokens=1))
+        admitted, shed = [], 0
+        get_injector().reset(seed=1234)
+        get_injector().arm("lane_eviction", rate=0.25, times=2)
+        try:
+            for i in range(n_burst):
+                p = a_prompt(a_len)
+                if eng.should_shed(need_tokens=len(p) + 1):
+                    shed += 1
+                    continue
+                rid = f"adm-{i}"
+                eng.submit(GenerationRequest(
+                    request_id=rid, prompt_ids=p,
+                    sampling=SamplingParams(max_tokens=a_gen)))
+                admitted.append(rid)
+            while eng.has_work:
+                eng.step()
+        finally:
+            get_injector().reset()
+        res = [eng.poll(r) for r in admitted]
+        clean = all(r is not None and r.finish_reason != "error"
+                    and len(r.token_ids) == a_gen for r in res)
+        del eng
+        return len(admitted), shed, clean
+
+    tier_admitted, tier_shed, tier_clean = run("tier")
+    dev_admitted, dev_shed, dev_clean = run("device")
+    log(f"tier admission: tier policy admitted {tier_admitted}/{n_burst} "
+        f"(clean={tier_clean}) vs device-only {dev_admitted}/{n_burst} "
+        f"at equal pool bytes")
+    return {
+        "tier_admission_lanes": tier_admitted,
+        "tier_admission_shed": tier_shed,
+        "tier_admission_clean": tier_clean,
+        "device_admission_lanes": dev_admitted,
+        "device_admission_shed": dev_shed,
+    }
+
+
 def main() -> None:
     t0 = time.monotonic()
     cache_was_warm = CACHE_DIR.is_dir() and any(CACHE_DIR.iterdir())
@@ -636,6 +837,14 @@ def main() -> None:
         # `make bench-mesh`: just the TP-mesh leg.  Dryrun on the forced
         # 8-host-device CPU mesh in CI; measured on a real slice.
         stats = mesh_leg(cfg, params)
+        try:
+            stats.update(overlap_leg(cfg, params))
+        except Exception as exc:  # noqa: BLE001 — extras never fail the bench
+            log(f"overlap leg skipped: {exc}")
+        try:
+            stats.update(tier_admission_leg(cfg, params))
+        except Exception as exc:  # noqa: BLE001
+            log(f"tier admission leg skipped: {exc}")
         print(json.dumps({
             "metric": "mesh_tok_s",
             "value": stats.get("mesh_tok_s", 0.0),
@@ -1041,6 +1250,10 @@ def main() -> None:
             mesh_stats = mesh_leg(cfg, params)
         except Exception as exc:  # noqa: BLE001 — extras never fail the bench
             log(f"mesh leg skipped: {exc}")
+        try:
+            mesh_stats.update(overlap_leg(cfg, params))
+        except Exception as exc:  # noqa: BLE001
+            log(f"overlap leg skipped: {exc}")
 
     # --- W8A8 leg: dynamic per-token activation int8 on top of the int8
     # weights — prefill runs s8 x s8 on the MXU int8 path (measured ~203

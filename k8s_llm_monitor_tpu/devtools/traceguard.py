@@ -46,8 +46,12 @@ FORBIDDEN_PRIMITIVES = frozenset({
 #: donation-rebinding assertions, now over collective-aware programs;
 #: "quant" builds the engine with kv_dtype="int8" so the quantize-on-append
 #: prefill/decode programs and the widened donation set (page pool PLUS the
-#: per-page scale leaves) are held to the same zero-recompile gate.
-DEFAULT_PATHS = ("gather", "fused", "mesh", "quant")
+#: per-page scale leaves) are held to the same zero-recompile gate;
+#: "overlap" is the mesh engine with the hand-staged reduce-scatter/
+#: all-gather decode schedule forced on (parallel/overlap.py) — the mesh
+#: path itself pins tp_overlap="off" so the GSPMD reference program stays
+#: gated alongside the overlap one.
+DEFAULT_PATHS = ("gather", "fused", "mesh", "quant", "overlap")
 
 
 def force_cpu() -> None:
@@ -135,13 +139,19 @@ def build_engine(decode_path: str = "gather", seed: int = 0):
 
     mesh = None
     kv_dtype = "auto"
-    if decode_path == "mesh":
+    tp_overlap = "off"
+    if decode_path in ("mesh", "overlap"):
         from k8s_llm_monitor_tpu.parallel.mesh import MeshConfig, create_mesh
 
         tp = len(jax.devices())
         mesh = create_mesh(MeshConfig(model=tp))
         cfg = _tiny_cfg(fused=False, mesh_tp=tp)
         impl = select_decode_impl(cfg=cfg, mesh=mesh, mode="gather")
+        # "mesh" pins the GSPMD-auto program (the correctness reference);
+        # "overlap" requires the staged schedule — build fails loudly if
+        # the tiny config ever stops clearing overlap_supported().
+        if decode_path == "overlap":
+            tp_overlap = "on" if tp > 1 else "off"
     elif decode_path == "quant":
         # attn_impl=None: the engine's select_decode_impl call sees the
         # quantized pool and picks the dequantizing path itself — the same
@@ -158,7 +168,7 @@ def build_engine(decode_path: str = "gather", seed: int = 0):
         prefill_buckets=(16, 32), max_prefills_per_step=2,
         max_admission_rounds=2, decode_steps_per_iter=4, max_inflight=2,
         spec_k=0, prefix_cache_entries=0, sample_topk_cap=8,
-        kv_dtype=kv_dtype,
+        kv_dtype=kv_dtype, tp_overlap=tp_overlap,
     )
     engine = InferenceEngine(cfg, params, engine_cfg=ec, eos_id=-1,
                              attn_impl=impl, mesh=mesh)

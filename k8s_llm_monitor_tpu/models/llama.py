@@ -276,6 +276,71 @@ def _linear(p: Params, x: jnp.ndarray, act_quant: bool = False) -> jnp.ndarray:
     return y
 
 
+def row_parallel_partial(p: Params, x: jnp.ndarray, act_quant: bool,
+                         axis_name: str):
+    """Shard-local half of a row-parallel ``_linear`` for hand-staged
+    reduction under ``shard_map`` (parallel/overlap.py).
+
+    Returns ``(partial, finish)``: ``partial`` is this shard's
+    un-reduced contribution [..., out] (int32 under W8A8 — integer
+    addition is associative, so reducing the raw dot output across
+    shards is bit-exact in any order); ``finish`` maps the reduced (or
+    reduce-scattered) array back to activation dtype, slicing the
+    per-out-channel dequant scale to the shard's chunk when the caller
+    hands it a scattered slice.
+
+    Exactness contract vs the GSPMD-auto psum of ``_linear``:
+      * W8A8: the per-token amax is GLOBAL over the contraction dim —
+        GSPMD computes it on the replicated activation, so the shard-local
+        amax must be ``pmax``-combined (max is order-independent, exact)
+        before quantizing, and the int32 partials must be reduced BEFORE
+        the float scales apply, in the same multiply order.
+      * weight-only int8: per-out-channel scales commute with the
+        contraction, so they apply after the reduce, sliced to the chunk.
+    Row projections never carry a bias in the supported model families
+    (``overlap_supported`` gates on it): a bias must be added exactly
+    once, not once per shard.
+    """
+    if "kernel_q" in p and act_quant:
+        amax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                       keepdims=True)
+        amax = jax.lax.pmax(amax, axis_name)
+        scale = jnp.maximum(amax / 127.0, 1e-8)
+        x_q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
+                       -127, 127).astype(jnp.int8)
+        part = jax.lax.dot_general(
+            x_q, p["kernel_q"],
+            (((x.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.int32,
+        )
+
+        def finish(y: jnp.ndarray) -> jnp.ndarray:
+            ws = _out_chunk(p["scale"], y.shape[-1], axis_name)
+            return (y.astype(jnp.float32) * scale * ws).astype(x.dtype)
+    elif "kernel_q" in p:
+        part = x @ p["kernel_q"].astype(x.dtype)
+
+        def finish(y: jnp.ndarray) -> jnp.ndarray:
+            ws = _out_chunk(p["scale"], y.shape[-1], axis_name)
+            return y * ws.astype(y.dtype)
+    else:
+        part = x @ p["kernel"]
+
+        def finish(y: jnp.ndarray) -> jnp.ndarray:
+            return y
+    return part, finish
+
+
+def _out_chunk(vec: jnp.ndarray, chunk: int, axis_name: str) -> jnp.ndarray:
+    """This shard's contiguous chunk of a replicated per-out-channel
+    vector (row-parallel o/down scales replicate under partition_rules —
+    no regex matches them — so each shard slices its own piece)."""
+    if vec.shape[0] == chunk:
+        return vec
+    idx = jax.lax.axis_index(axis_name)
+    return jax.lax.dynamic_slice_in_dim(vec, idx * chunk, chunk, axis=0)
+
+
 def _embed_lookup(params: Params, cfg: ModelConfig,
                   tokens: jnp.ndarray) -> jnp.ndarray:
     """Token embedding lookup, handling int8-quantized tables."""

@@ -61,13 +61,15 @@ class TPULLMConfig:
     # 0 disables.  Every sampling mode speculates (greedy bit-identically;
     # sampled — incl. top-k/top-p — distribution-exactly), emitting up to
     # spec_k+1 tokens per verify forward when the output quotes its
-    # context.  OFF by default: the win depends on a checkpoint whose
-    # answers actually quote (random-init bench weights measure the 1.0
-    # acceptance floor on every workload construction tried — see
-    # bench.py's spec leg); enable for real diagnosis checkpoints, where
-    # the adaptive engine falls back to the fused path whenever measured
-    # acceptance is below engine spec_min_accept anyway.
-    spec_k: int = 0
+    # context.  ON by default for the monitor: diagnosis answers are
+    # template-heavy (they quote pod names, container states, and log
+    # lines straight out of the evidence prompt — exactly the regime
+    # prompt-lookup drafts for), and the downside is bounded twice over:
+    # the AcceptanceEMA kill-switch (spec_min_accept below) auto-disables
+    # drafting per request class when measured acceptance cannot pay for
+    # the verify forwards, and brownout (resilience/slo.py ladder) turns
+    # speculation off wholesale under pressure.  Set 0 to opt out.
+    spec_k: int = 4
     # Acceptance floor for the per-request-class speculative kill-switch
     # (serving/spec.py AcceptanceEMA): when a class's accepted-tokens-per-
     # lane-round EMA drops below this, drafting auto-disables for that

@@ -226,6 +226,17 @@ def _engine_metrics(w: _Writer, engine) -> None:
                  "Host-tier entries dropped under host-buffer pressure "
                  "(next hit falls back to prompt replay)",
                  [("", t["host_lost"])])
+    # Tier-aware admission headroom (engine.admission_headroom_tokens):
+    # the token capacity should_shed()'s kv_admission clause admits
+    # against — free device blocks plus, under the "tier" policy, the
+    # spillable prefix-cache span the host tier has room for.
+    headroom_fn = getattr(engine, "admission_headroom_tokens", None)
+    if callable(headroom_fn):
+        w.metric("kv_admission_headroom_tokens", "gauge",
+                 "KV tokens the admission capacity clause can still "
+                 "place (device free + host-spillable under "
+                 "kv_admission=tier)",
+                 [("", headroom_fn())])
     w.metric("engine_chunk_shrinks_total", "counter",
              "Chunked-prefill rounds shrunk below the configured bucket "
              "because interactive-class work was queued",
@@ -273,6 +284,18 @@ def _engine_metrics(w: _Writer, engine) -> None:
                  "profile_decode_phases() has run",
                  [("", round(getattr(engine, "decode_collective_share",
                                      0.0), 4))])
+        w.metric("engine_tp_overlap", "gauge",
+                 "1 when the hand-staged reduce-scatter/all-gather decode "
+                 "schedule is active (parallel/overlap.py); 0 = GSPMD "
+                 "reference program",
+                 [("", 1 if getattr(engine, "tp_overlap", False) else 0)])
+        w.metric("engine_decode_collective_hidden_share", "gauge",
+                 "Fraction of the per-step ring wire time the overlap "
+                 "schedule hides under compute (measured on TPU, "
+                 "analytic in dryrun); 0 until estimate_hidden_share() "
+                 "has run",
+                 [("", round(getattr(
+                     engine, "decode_collective_hidden_share", 0.0), 4))])
 
     # Decode-step phase attribution (fused fast-path observability).
     # attn/sample are populated by engine.profile_decode_phases() — a

@@ -44,6 +44,30 @@ def ici_bandwidth_gbs(device_kind: str) -> float:
     return _ICI_GBS_DEFAULT
 
 
+# Per-chip HBM bandwidth (GB/s), same keying as ICI_GBS.  Paired with it
+# in the overlap decode model (serving/engine.py:estimate_hidden_share):
+# decode is weight-streaming bound, so the window available to hide a
+# reduce-scatter/all-gather half under the next column-parallel matmul is
+# the time that matmul spends streaming its weight shard from HBM.
+HBM_GBS = {
+    "v5 lite": 819.0,
+    "v5e": 819.0,
+    "v5p": 2765.0,
+    "v4": 1228.0,
+    "v6": 1640.0,       # v6e (Trillium)
+}
+_HBM_GBS_DEFAULT = 819.0
+
+
+def hbm_bandwidth_gbs(device_kind: str) -> float:
+    """Per-chip HBM bandwidth for ``device_kind`` (GB/s)."""
+    kind = device_kind.lower()
+    for key, gbs in HBM_GBS.items():
+        if key in kind:
+            return gbs
+    return _HBM_GBS_DEFAULT
+
+
 def init_multihost(coordinator: str | None = None,
                    num_processes: int | None = None,
                    process_id: int | None = None) -> int:

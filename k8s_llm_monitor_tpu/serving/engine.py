@@ -278,6 +278,23 @@ class EngineConfig:
     # Brownout clamp on batch-class max_tokens applied at admission while
     # the ladder sits at DEGRADED or worse; 0 disables the clamp.
     brownout_batch_max_tokens: int = 64
+    # --- TP collective overlap (parallel/overlap.py) ------------------
+    # Decode-step collective schedule under a TP mesh.  "auto" (default):
+    # the hand-staged reduce-scatter/all-gather program whenever
+    # overlap_supported() clears the (cfg, mesh) — byte-identical to the
+    # GSPMD reference, with the per-layer wire time hidden under the next
+    # sub-block's weight streaming.  "on": require it (ValueError when
+    # unsupported).  "off": always the GSPMD-auto psum program.  Env
+    # override: K8SLLM_TP_OVERLAP, same values.
+    tp_overlap: str = "auto"
+    # --- tier-aware admission (ROADMAP item 2 / PR 9 ladder) ----------
+    # What counts as KV headroom in should_shed()'s capacity clause:
+    # "tier" (default) counts free device blocks PLUS prefix-cache blocks
+    # a lossless host spill could reclaim (bounded by HostKVTier free
+    # bytes), so admission tracks the capacity the eviction path can
+    # actually deliver; "device" counts free device blocks only; "off"
+    # disables the clause (pre-PR-12: rely on OutOfBlocks pushback).
+    kv_admission: str = "tier"
 
 
 class _Slot:
@@ -456,12 +473,18 @@ class InferenceEngine:
                 v=[jax.device_put(x, NamedSharding(mesh, s))
                    for x, s in zip(pages.v, kvspecs.v)],
                 # Scale leaves shard their kv-heads axis exactly when the
-                # pages' fused lane dim does (SpecLayout.kv_scales); empty
-                # for unquantized pools.
+                # pages' fused lane dim does (SpecLayout.kv_scales).  An
+                # unquantized pool keeps the EMPTY-TUPLE containers from
+                # init_kv_pages — an empty list here is a different
+                # treedef from what prefill/decode return, so the first
+                # dispatch would silently fork a second variant of every
+                # program that takes pages.
                 k_scale=[jax.device_put(x, NamedSharding(mesh, s))
-                         for x, s in zip(pages.k_scale, kvspecs.k_scale)],
+                         for x, s in zip(pages.k_scale, kvspecs.k_scale)]
+                if pages.quantized else (),
                 v_scale=[jax.device_put(x, NamedSharding(mesh, s))
-                         for x, s in zip(pages.v_scale, kvspecs.v_scale)],
+                         for x, s in zip(pages.v_scale, kvspecs.v_scale)]
+                if pages.quantized else (),
             )
         self.params = params
         self.pages = pages
@@ -510,6 +533,42 @@ class InferenceEngine:
             self.decode_path = "gather"
         else:
             self.decode_path = "pallas"
+        # TP collective overlap: swap the GSPMD-auto decode program for the
+        # hand-staged reduce-scatter/all-gather schedule
+        # (parallel/overlap.py).  The step is built once here and captured
+        # by _step_core, so the scan programs and their donation/caching
+        # behavior are untouched — overlap-on vs overlap-off differ only
+        # in the traced layer body.
+        self._overlap_step = None
+        self.tp_overlap = False
+        overlap_mode = os.environ.get("K8SLLM_TP_OVERLAP",
+                                      ec.tp_overlap) or "auto"
+        if overlap_mode not in ("auto", "on", "off"):
+            raise ValueError(
+                f"unknown tp_overlap {overlap_mode!r} (auto | on | off)")
+        if overlap_mode != "off":
+            from k8s_llm_monitor_tpu.parallel.overlap import (
+                make_overlap_decode_step,
+                overlap_supported,
+            )
+
+            why_not = overlap_supported(cfg, mesh, params=self.params)
+            if not why_not:
+                self._overlap_step = make_overlap_decode_step(
+                    mesh, cfg, self.params, self.pages,
+                    attn_path=self.decode_path)
+                self.tp_overlap = True
+            elif overlap_mode == "on":
+                raise ValueError(
+                    f"tp_overlap=on but the overlap schedule cannot serve "
+                    f"this (cfg, mesh): {why_not}")
+            elif mesh is not None and mesh.shape.get("model", 1) > 1:
+                logger.warning("tp_overlap=auto: staying on the GSPMD "
+                               "schedule (%s)", why_not)
+        # Measured share of the per-step ring time the overlap schedule
+        # hides; estimate_hidden_share() fills it from profile/bench runs
+        # and the exporter publishes it.
+        self.decode_collective_hidden_share = 0.0
         # Multi-query attention for the speculative verify pass (Pallas
         # kernel on compatible single-chip TPU; XLA gather otherwise).
         # Quantized pools drop the dedicated verify kernel: llama's
@@ -866,13 +925,46 @@ class InferenceEngine:
     def active_slots(self) -> int:
         return sum(1 for s in self._slots if s is not None)
 
-    def should_shed(self, slo_class: str = DEFAULT_CLASS) -> str:
+    def admission_headroom_tokens(self) -> int:
+        """KV capacity (tokens) admission may count on, per the
+        ``kv_admission`` policy.
+
+        ``device``/``off``: tokens the free device blocks cover.  ``tier``
+        additionally counts prefix-cache blocks a LOSSLESS host spill
+        could reclaim — ``evictable_blocks`` bounded by the host tier's
+        free bytes — because that is exactly the capacity ``_ensure_free``
+        can deliver without destroying cache content.  With no host tier
+        configured there is nothing to spill to, so the tier bonus is 0
+        (eviction would drop prefixes; the queue + OutOfBlocks pushback
+        stay the arbiter, as before this knob existed).  Exported as the
+        ``kv_admission_headroom_tokens`` gauge."""
+        ec = self.ecfg
+        free_blocks = self.allocator.free_blocks
+        if (ec.kv_admission == "tier" and self.prefix_cache is not None
+                and self.host_kv_tier is not None):
+            evictable = self.prefix_cache.evictable_blocks()
+            if evictable > 0:
+                cfg = self.cfg
+                pdtype = np.dtype(self.pages.k[0].dtype)
+                blk_bytes = cfg.num_layers * page_slice_bytes(
+                    cfg.num_kv_heads, cfg.head_dim_, ec.block_size,
+                    pdtype.itemsize, scale_bytes=4 if self.kv_quant else 0)
+                st = self.host_kv_tier.stats()
+                host_free = max(st["max_bytes"] - st["bytes"], 0)
+                free_blocks += min(evictable, host_free // max(blk_bytes, 1))
+        return free_blocks * ec.block_size
+
+    def should_shed(self, slo_class: str = DEFAULT_CLASS,
+                    need_tokens: int = 0) -> str:
         """Non-empty reason when new work of ``slo_class`` should be shed
         (admission control): queue-token backlog or admission-wait EMA
-        above the configured thresholds.  The caller (EngineService.submit)
-        turns this into a retriable ``OverloadedError``; the engine itself
-        never rejects — by the time work reaches ``submit()`` the caller
-        has already been told to back off.
+        above the configured thresholds, or — when the caller passes the
+        request's KV footprint as ``need_tokens`` — a footprint the
+        tier-aware headroom cannot cover (``kv_admission`` policy).  The
+        caller (EngineService.submit) turns this into a retriable
+        ``OverloadedError``; the engine itself never rejects — by the time
+        work reaches ``submit()`` the caller has already been told to back
+        off.
 
         Shedding is class-ordered: a request is charged only for backlog
         of its own class and above (queued lower-class tokens would be
@@ -897,6 +989,21 @@ class InferenceEngine:
         if 0 < ec.shed_slot_wait_s <= self.slot_wait_ema_s:
             return (f"admission wait EMA {self.slot_wait_ema_s:.2f}s >= "
                     f"{ec.shed_slot_wait_s:.2f}s")
+        # Capacity clause: checked after the class ordering above so that
+        # queued-lower-class eviction/preemption gets first refusal — it
+        # can free device blocks the headroom figure does not count.
+        # "tier" only arms it when a host tier is actually configured:
+        # without one the headroom figure would say nothing the legacy
+        # queue + OutOfBlocks pushback does not already handle.
+        capacity_armed = (ec.kv_admission == "device"
+                          or (ec.kv_admission == "tier"
+                              and self.host_kv_tier is not None))
+        if need_tokens > 0 and capacity_armed:
+            headroom = self.admission_headroom_tokens()
+            if need_tokens > headroom:
+                return (f"kv capacity: request needs {need_tokens} tokens, "
+                        f"admission headroom is {headroom} "
+                        f"(kv_admission={ec.kv_admission})")
         return ""
 
     def generate(self, prompts: list[list[int]],
@@ -2176,13 +2283,21 @@ class InferenceEngine:
         cfg = self.cfg
         attn_impl = self._attn_impl
         k_cap = self.ecfg.sample_topk_cap
+        overlap_step = self._overlap_step
 
         def _step_core(params, tokens, ctx, act, pages, tables):
             ctx_eff = jnp.where(act, ctx, 0)
-            logits, pages = llama.decode_step(
-                params, cfg, tokens, ctx_eff, pages, tables,
-                attn_impl=attn_impl,
-            )
+            if overlap_step is not None:
+                # Hand-staged TP schedule (parallel/overlap.py): same
+                # calling convention minus attn_impl, which the builder
+                # resolved from self.decode_path at engine construction.
+                logits, pages = overlap_step(
+                    params, tokens, ctx_eff, pages, tables)
+            else:
+                logits, pages = llama.decode_step(
+                    params, cfg, tokens, ctx_eff, pages, tables,
+                    attn_impl=attn_impl,
+                )
             return logits, pages
 
         if sampled and constrained:
@@ -2371,7 +2486,19 @@ class InferenceEngine:
         estimate — collectives overlap compute on real meshes — and on the
         forced-host CPU mesh the step time itself is a dryrun stand-in.
         """
-        if self.mesh is None or step_ms <= 0.0:
+        ici_ms = self._ring_ici_ms()
+        if ici_ms <= 0.0 or step_ms <= 0.0:
+            return 0.0
+        return min(1.0, ici_ms / step_ms)
+
+    def _ring_ici_ms(self) -> float:
+        """Per-step wire time of the TP decode collectives (byte model,
+        ms): row-parallel o/down each move ``2*(tp-1)/tp`` of a
+        [max_slots, hidden] activation over each chip's ICI links per
+        layer — the same bytes whether staged as one ring all-reduce
+        (GSPMD) or as a reduce-scatter + all-gather pair (overlap path).
+        0.0 off-mesh / TP=1."""
+        if self.mesh is None:
             return 0.0
         tp = self.mesh.shape.get("model", 1)
         if tp <= 1:
@@ -2384,8 +2511,57 @@ class InferenceEngine:
         per_chip_bytes = (2 * cfg.num_layers          # o-proj + down-proj
                           * 2.0 * (tp - 1) / tp * payload)
         kind = self.mesh.devices.flat[0].device_kind
-        ici_ms = per_chip_bytes / (ici_bandwidth_gbs(kind) * 1e9) * 1e3
-        return min(1.0, ici_ms / step_ms)
+        return per_chip_bytes / (ici_bandwidth_gbs(kind) * 1e9) * 1e3
+
+    def estimate_hidden_share(self, step_ms_on: float | None = None,
+                              step_ms_off: float | None = None) -> float:
+        """``decode_collective_hidden_share``: fraction of the per-step
+        ring wire time the overlap schedule hides under compute.
+
+        On TPU, with measured overlap-on and overlap-off step times, the
+        hidden share is the observed saving against the byte model:
+        ``(off - on) / ring_ici_ms``, clamped to [0, 1].
+
+        Off-TPU (the forced-host dev mesh), interpreter step times are
+        meaningless, so the dryrun falls back to the analytic window
+        model: a reduce-scatter/all-gather half is hidden up to the time
+        the next column-parallel matmuls spend streaming their weight
+        shard HBM->VMEM (decode is weight-streaming bound).  Per layer
+        that window is the per-chip column weight bytes over HBM
+        bandwidth; the wire is the per-layer share of ``_ring_ici_ms``.
+        Both the measured and analytic figures land in
+        ``self.decode_collective_hidden_share`` for /metrics.
+        """
+        share = 0.0
+        ici_ms = self._ring_ici_ms()
+        if ici_ms <= 0.0 or not self.tp_overlap:
+            self.decode_collective_hidden_share = 0.0
+            return 0.0
+        on_tpu = jax.default_backend() == "tpu"
+        if (on_tpu and step_ms_on is not None and step_ms_off is not None
+                and step_ms_off > 0.0):
+            share = max(0.0, min(1.0, (step_ms_off - step_ms_on) / ici_ms))
+        else:
+            from k8s_llm_monitor_tpu.parallel.mesh import hbm_bandwidth_gbs
+
+            cfg = self.cfg
+            tp = self.mesh.shape.get("model", 1)
+            # int8 weights stream 1 byte/element; float params their dtype.
+            layer0 = self.params["layers"][0]
+            wbytes = (1 if "kernel_q" in layer0["q"]
+                      else (4 if cfg.dtype == "float32" else 2))
+            D = cfg.head_dim_
+            col_weights = (cfg.hidden_size * cfg.num_heads * D       # q
+                           + 2 * cfg.hidden_size * cfg.num_kv_heads * D
+                           + 2 * cfg.hidden_size * cfg.intermediate_size)
+            stream_ms = (col_weights * wbytes / tp
+                         / (hbm_bandwidth_gbs(
+                             self.mesh.devices.flat[0].device_kind) * 1e9)
+                         * 1e3)
+            wire_ms = ici_ms / (2 * cfg.num_layers)   # one RS/AG pair
+            share = min(1.0, stream_ms / wire_ms) if wire_ms > 0 else 0.0
+        self.decode_collective_hidden_share = share
+        return share
 
     @staticmethod
     def _spec_class(lanes) -> str:

@@ -267,6 +267,22 @@ class PrefixCache:
             self.allocator.incref(shared)
             self._entries[key] = _PrefixEntry(tuple(shared))
 
+    def evictable_blocks(self) -> int:
+        """Blocks an eviction sweep could return to the free list right
+        now: those whose every reference is cache-owned (live slots pin
+        theirs, and a pinned block survives eviction — ``free`` only
+        decrefs).  One entry per prefix length means a block is covered by
+        several entries; it is evictable iff its allocator refcount equals
+        that coverage.  Tier-aware admission
+        (engine.admission_headroom_tokens) counts these as capacity the
+        spill path can deliver without losing cache content."""
+        coverage: dict[int, int] = {}
+        for entry in self._entries.values():
+            for b in entry.blocks:
+                coverage[b] = coverage.get(b, 0) + 1
+        return sum(1 for b, n in coverage.items()
+                   if self.allocator.ref_count(b) == n)
+
     def peek_lru(self) -> tuple[bytes, list[int]] | None:
         """The LRU entry's (chain digest, block ids) without evicting or
         touching refcounts — the engine's host-spill wrapper reads the

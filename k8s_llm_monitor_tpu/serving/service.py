@@ -292,7 +292,11 @@ class EngineService:
                                       retry_after_s=hint,
                                       slo_class=slo_class,
                                       request_id=request_id)
-            reason = self.engine.should_shed(slo_class)
+            # Prompt + first sampled token is the KV footprint admission
+            # must eventually place (engine._admit_round allocates L+1) —
+            # the tier-aware capacity clause checks it against headroom.
+            reason = self.engine.should_shed(
+                slo_class, need_tokens=len(prompt_ids) + 1)
             if reason:
                 hint = self._record_shed(slo_class, request_id, reason,
                                          trace_ctx)

@@ -183,7 +183,7 @@ def test_service_per_class_retry_after_streaks(params):
     svc = EngineService(eng)
     try:
         real_shed = eng.should_shed
-        eng.should_shed = lambda slo_class="standard": "forced overload"
+        eng.should_shed = lambda slo_class="standard", need_tokens=0: "forced overload"
         hints = {"batch": [], "interactive": []}
         for _ in range(5):
             with pytest.raises(OverloadedError) as ei:
@@ -207,7 +207,7 @@ def test_service_per_class_retry_after_streaks(params):
         eng.should_shed = real_shed
         svc.submit([1, 2, 3], SamplingParams(max_tokens=2),
                    slo_class="batch").result(timeout=30)
-        eng.should_shed = lambda slo_class="standard": "forced overload"
+        eng.should_shed = lambda slo_class="standard", need_tokens=0: "forced overload"
         with pytest.raises(OverloadedError) as ei:
             svc.submit([1, 2, 3], SamplingParams(max_tokens=2),
                        slo_class="batch")
