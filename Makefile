@@ -7,9 +7,10 @@ SHELL := /bin/bash    # tier1 uses pipefail/PIPESTATUS
 
 .PHONY: run run-agent run-scheduler demo test test-fast tier1 tier1-mesh \
         chaos chaos-lifecycle chaos-fleet chaos-overload chaos-kvtier \
-        chaos-trace \
+        chaos-trace chaos-signals \
         diagnose-e2e bench bench-decode \
-        bench-fleet bench-mesh dryrun smoke preflight deploy-agent docker \
+        bench-fleet bench-mesh bench-signals dryrun smoke preflight \
+        deploy-agent docker \
         docker-agent docker-scheduler lint lint-trace clean
 
 run:
@@ -93,6 +94,14 @@ chaos-trace:
 	$(TEST_ENV) K8SLLM_LOCKCHECK=1 \
 	  $(PY) -m pytest tests/test_tracing.py -q -p no:cacheprovider
 
+# Telemetry-plane acceptance (docs/observability.md "Signals & time
+# series"): ring-store math under a fake clock, fleet staleness NaN
+# discipline, derived scale hints, the anomaly→diagnosis feed, and the
+# live 2-replica flood→scale-up→decay loop — with lock discipline checked.
+chaos-signals:
+	$(TEST_ENV) K8SLLM_LOCKCHECK=1 \
+	  $(PY) -m pytest tests/test_signals.py -q -p no:cacheprovider
+
 # Diagnosis acceptance (docs/diagnosis.md): grammar compiler units, the
 # constrained-sampling fuzz (every sample parses), and the synthetic
 # crash-loop burst → verdict e2e — with lock discipline checked.
@@ -121,6 +130,13 @@ bench-mesh:
 	  BENCH_MESH_CONCURRENCY=12 BENCH_MESH_PROMPT_LEN=48 \
 	  BENCH_MESH_MAX_TOKENS=12 BENCH_MESH_SLOTS=8 \
 	  $(PY) bench.py | tee mesh-bench.json
+
+# Telemetry-plane overhead smoke: scraper-on vs scraper-off tok/s on a
+# tiny CPU engine; asserts the < 1% budget and persists the derived
+# signal snapshot with the artifact.
+bench-signals:
+	$(TEST_ENV) BENCH_SIGNALS_ONLY=1 BENCH_MODEL=tiny BENCH_QUANT=none \
+	  $(PY) bench.py | tee signals-bench.json
 
 smoke:              # boot server + 20-check live API suite
 	$(TEST_ENV) bash scripts/smoke.sh

@@ -41,6 +41,9 @@ def _graceful_shutdown(srv, grace_s: float, log: logging.Logger) -> None:
     rec = get_flight_recorder()
     rec.note("sigterm", grace_s=grace_s)
     rec.dump("sigterm", extra={"grace_s": grace_s})
+    if srv.signals is not None:
+        srv.signals.stop()
+        log.info("signal scraper stopped")
     watcher = getattr(srv, "diagnosis_watcher", None)
     if watcher is not None:
         watcher.stop()
@@ -138,6 +141,8 @@ def main(argv: list[str] | None = None) -> int:
 
                 get_flight_recorder().dump("sigterm",
                                            extra={"role": "router"})
+                if srv.signals is not None:
+                    srv.signals.stop()
                 srv.analysis.close()
                 srv.request_shutdown()
 
@@ -150,6 +155,8 @@ def main(argv: list[str] | None = None) -> int:
             srv.serve_forever()
         finally:
             if not shutdown_started.is_set():
+                if srv.signals is not None:
+                    srv.signals.stop()
                 srv.analysis.close()
         return 0
 
@@ -234,6 +241,8 @@ def main(argv: list[str] | None = None) -> int:
         srv.serve_forever()
     finally:
         if not shutdown_started.is_set():
+            if srv.signals is not None:
+                srv.signals.stop()
             if srv.diagnosis_watcher is not None:
                 srv.diagnosis_watcher.stop()
             if srv.diagnosis is not None:

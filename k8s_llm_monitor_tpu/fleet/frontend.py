@@ -115,5 +115,25 @@ def build_router_server(config, web_dir=None):
     logger.info("router fronting %d replica(s), policy=%s, hedging=%s",
                 len(registry), fcfg.policy,
                 "on" if fcfg.hedge_enabled else "off")
-    return MonitorServer(
-        config=config, analysis=FleetAnalysis(router), web_dir=web_dir)
+    signals = None
+    if config.telemetry.enabled:
+        from k8s_llm_monitor_tpu.observability.flight import (
+            get_flight_recorder,
+        )
+        from k8s_llm_monitor_tpu.observability.signals import SignalScraper
+
+        # Router-role telemetry: fleet-merged series fed by the registry
+        # probes (telemetry_sample()), behind GET /api/v1/signals.  A
+        # router has no diagnosis pipeline by default — anomalies are
+        # still derived and reported; callers wanting self-diagnosis
+        # attach a pipeline to both srv.diagnosis and srv.signals.
+        signals = SignalScraper(cfg=config.telemetry)
+        get_flight_recorder().signal_source = (
+            lambda: signals.store.window_snapshot(
+                config.telemetry.flight_window_s))
+    srv = MonitorServer(
+        config=config, analysis=FleetAnalysis(router), web_dir=web_dir,
+        signals=signals)
+    if signals is not None:
+        signals.attach(srv)
+    return srv

@@ -49,6 +49,15 @@ class ReplicaStats:
     # spill/restore counters.  Absent on pre-tiering replicas — routing
     # never requires it; the fleet exporter and migration diagnostics do.
     kv_tier: dict = dataclasses.field(default_factory=dict)
+    # Signal-scraper inputs (telemetry plane): admission headroom in
+    # tokens (None on pre-telemetry replicas — None, not 0, so the
+    # scraper records a NaN marker instead of fake emptiness), per-class
+    # shed/preemption totals, and the per-class TTFT EMAs (classes with
+    # no completion yet are simply absent).
+    headroom_tokens: Optional[float] = None
+    shed_by_class: dict = dataclasses.field(default_factory=dict)
+    ttft_ema_by_class: dict = dataclasses.field(default_factory=dict)
+    preemptions_by_class: dict = dataclasses.field(default_factory=dict)
 
     @property
     def prefix_hit_rate(self) -> float:
@@ -61,6 +70,7 @@ class ReplicaStats:
         eng = (payload or {}).get("engine") or {}
         pc = eng.get("prefix_cache") or {}
         by_class = eng.get("queue_tokens_by_class") or {}
+        headroom = eng.get("admission_headroom_tokens")
         return cls(
             queue_depth=int(eng.get("queue_depth", 0)),
             queue_tokens=int(eng.get("queue_tokens", 0)),
@@ -71,6 +81,15 @@ class ReplicaStats:
             queue_by_class={str(k): int(v) for k, v in by_class.items()},
             brownout=int(eng.get("brownout", 0)),
             kv_tier=dict(eng.get("kv_tier") or {}),
+            headroom_tokens=(float(headroom) if headroom is not None
+                             else None),
+            shed_by_class={str(k): int(v) for k, v in
+                           (eng.get("shed_by_class") or {}).items()},
+            ttft_ema_by_class={str(k): float(v) for k, v in
+                               (eng.get("ttft_ema_by_class") or {}).items()},
+            preemptions_by_class={
+                str(k): int(v) for k, v in
+                (eng.get("preemptions_by_class") or {}).items()},
         )
 
 
@@ -108,6 +127,9 @@ class ReplicaRegistry:
         self._breaker_cooldown_s = breaker_cooldown_s
         self._probe_thread: Optional[threading.Thread] = None
         self._probe_stop = threading.Event()
+        # The cadence start_probes() runs at — the staleness yardstick
+        # the telemetry plane compares probe ages against.
+        self.probe_interval_s: float = 5.0
         self._entries: dict[str, _Entry] = {}
         # Created last (lockcheck: writes before the lock exists are
         # construction, not races).
@@ -182,6 +204,7 @@ class ReplicaRegistry:
     def start_probes(self, interval_s: float = 5.0) -> None:
         if self._probe_thread is not None:
             return
+        self.probe_interval_s = float(interval_s)
         self._probe_stop.clear()
 
         def _loop() -> None:
@@ -244,7 +267,11 @@ class ReplicaRegistry:
     # -- observability ---------------------------------------------------
 
     def snapshot(self) -> dict:
-        """Per-replica view for ``/api/v1/stats`` and the exporter."""
+        """Per-replica view for ``/api/v1/stats``, the exporter, and the
+        signal scraper.  ``probe_age_s`` is seconds since the last
+        completed probe — None until the first probe finishes (the
+        telemetry plane treats None as maximally stale)."""
+        now = time.monotonic()
         with self._lock:
             return {
                 rid: {
@@ -262,6 +289,13 @@ class ReplicaRegistry:
                     "total_slots": e.stats.total_slots,
                     "prefix_hit_rate": round(e.stats.prefix_hit_rate, 4),
                     "kv_tier": dict(e.stats.kv_tier),
+                    "headroom_tokens": e.stats.headroom_tokens,
+                    "shed_by_class": dict(e.stats.shed_by_class),
+                    "ttft_ema_by_class": dict(e.stats.ttft_ema_by_class),
+                    "preemptions_by_class":
+                        dict(e.stats.preemptions_by_class),
+                    "probe_age_s": (round(now - e.last_probe_s, 3)
+                                    if e.last_probe_s > 0 else None),
                 }
                 for rid, e in self._entries.items()
             }

@@ -153,6 +153,10 @@ class LocalReplica(Replica):
             queue_by_class=engine.queue_tokens_by_class(),
             brownout=engine.brownout() if engine.brownout is not None else 0,
             kv_tier=engine.kv_tier_stats(),
+            headroom_tokens=float(engine.admission_headroom_tokens()),
+            shed_by_class=dict(svc.shed_count_by_class),
+            ttft_ema_by_class=dict(engine.ttft_ema_by_class),
+            preemptions_by_class=dict(engine.preemptions_by_class),
         )
 
     def generate(self, prompt_ids: list[int], sampling=None,

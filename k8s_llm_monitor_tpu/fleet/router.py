@@ -284,6 +284,17 @@ class FleetRouter:
                 "prefix_migrations": dict(self._migrations),
             }
 
+    def telemetry_sample(self) -> dict:
+        """The signal scraper's fleet input: every replica's last probe
+        row plus the probe cadence the staleness rule is judged against.
+        One registry lock pass, no HTTP — the probe loop already paid
+        for the data."""
+        return {
+            "replicas": self.registry.snapshot(),
+            "probe_interval_s": self.registry.probe_interval_s,
+            "counters": self.counters(),
+        }
+
     def replicas(self) -> list[tuple[str, object]]:
         """(replica_id, Replica) pairs — the cross-replica trace merge in
         ``GET /api/v1/trace/<id>`` walks every registered replica, ready

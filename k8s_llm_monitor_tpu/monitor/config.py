@@ -233,6 +233,43 @@ class FleetConfig:
 
 
 @dataclass
+class TelemetryConfig:
+    """Fleet telemetry plane (observability/timeseries.py + signals.py):
+    the in-tree time-series store, the signal scraper, and the derived
+    autoscaler/anomaly contract behind GET /api/v1/signals.  New; no
+    reference equivalent."""
+
+    enabled: bool = True
+    # Scraper cadence and store bounds: points kept per series, series
+    # allowed in the store (label-cardinality blast-radius cap).
+    scrape_interval_s: float = 2.0
+    ring_points: int = 512
+    max_series: int = 2048
+    # Default trailing window for derived signals and /api/v1/timeseries.
+    window_s: float = 60.0
+    ema_half_life_s: float = 10.0
+    # scale_hint thresholds: per-class queue-token growth rate that reads
+    # as "scale up", and the brownout dwell fraction (share of window
+    # samples at rung >= degraded) that does the same.
+    queue_growth_up_tok_s: float = 50.0
+    brownout_dwell_up: float = 0.5
+    # Per-class TTFT budgets (seconds) for sustained-breach detection.
+    ttft_budget_interactive_s: float = 1.0
+    ttft_budget_standard_s: float = 2.5
+    ttft_budget_batch_s: float = 10.0
+    # Anomaly edge-trigger cooldown per (target, flag), and whether
+    # anomalies feed the diagnosis pipeline as self_monitor events.
+    anomaly_cooldown_s: float = 30.0
+    feed_diagnosis: bool = True
+    # Replica probe-staleness multiple (router role): stats older than
+    # this many probe intervals get NaN markers, not frozen values.
+    stale_after_probes: float = 3.0
+    # Trailing seconds of the series window snapshotted into flight-
+    # recorder crash artifacts (v2 "signals" block).
+    flight_window_s: float = 30.0
+
+
+@dataclass
 class LoggingConfig:
     level: str = "info"
     format: str = "json"  # ref config.go default
@@ -251,6 +288,7 @@ class Config:
     diagnosis: DiagnosisConfig = field(default_factory=DiagnosisConfig)
     lifecycle: LifecycleConfig = field(default_factory=LifecycleConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
 
 

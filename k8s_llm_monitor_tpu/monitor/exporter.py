@@ -499,6 +499,7 @@ def _fleet_metrics(w: _Writer, router) -> None:
     the router's hedging/failover/affinity counters (PR 5)."""
     snap = router.registry.snapshot()
     ready, inflight, hit_rate, dispatches, failures = [], [], [], [], []
+    ages = []
     for rid, rep in sorted(snap.items()):
         label = f'{{replica="{rid}"}}'
         ready.append((label, 1 if rep["ready"] else 0))
@@ -506,6 +507,8 @@ def _fleet_metrics(w: _Writer, router) -> None:
         hit_rate.append((label, rep["prefix_hit_rate"]))
         dispatches.append((label, rep["dispatches"]))
         failures.append((label, rep["failures"]))
+        age = rep.get("probe_age_s")
+        ages.append((label, age if age is not None else float("nan")))
     if ready:
         w.metric("fleet_replica_ready", "gauge",
                  "Replica readiness as the router sees it", ready)
@@ -520,6 +523,12 @@ def _fleet_metrics(w: _Writer, router) -> None:
         w.metric("fleet_replica_failures_total", "counter",
                  "Dispatch/stream failures the router observed per replica",
                  failures)
+        # NaN = never probed, not "0 seconds ago" — a frozen stats row
+        # must read as stale, never fresh (the scraper marks replicas
+        # stale past stale_after_probes × probe interval).
+        w.metric("fleet_scrape_age_s", "gauge",
+                 "Seconds since each replica's last completed stats probe "
+                 "(NaN = never probed)", ages)
     c = router.counters()
     w.metric("fleet_affinity_hits_total", "counter",
              "Dispatches that landed on the policy's preferred replica",
@@ -624,6 +633,32 @@ def _device_metrics(w: _Writer) -> None:
              [("", len(devices))])
 
 
+def _telemetry_metrics(w: _Writer, scraper) -> None:
+    """Signal-scraper self-accounting (the telemetry plane watching
+    itself): scrape cadence health and store occupancy."""
+    c = scraper.counters()
+    w.metric("telemetry_scrapes_total", "counter",
+             "Signal-scraper sampling passes completed",
+             [("", c["scrapes_total"])])
+    w.metric("telemetry_scrape_errors_total", "counter",
+             "Signal-scraper passes that raised and were dropped",
+             [("", c["scrape_errors_total"])])
+    w.metric("telemetry_anomalies_total", "counter",
+             "Anomaly flags raised by the derived-signal layer "
+             "(edge-triggered, per target+flag cooldown)",
+             [("", c["anomalies_total"])])
+    t = scraper.store.totals()
+    w.metric("telemetry_series", "gauge",
+             "Live time series held by the in-process store",
+             [("", t["series"])])
+    w.metric("telemetry_points_total", "counter",
+             "Points recorded into the time-series store",
+             [("", t["points_total"])])
+    w.metric("telemetry_dropped_series_total", "counter",
+             "Series refused because the store hit max_series",
+             [("", t["dropped_series_total"])])
+
+
 def _tracing_metrics(w: _Writer) -> None:
     """Tracer + flight-recorder self-accounting."""
     from k8s_llm_monitor_tpu.observability.flight import get_flight_recorder
@@ -675,6 +710,9 @@ def render_prometheus(srv: "MonitorServer", openmetrics: bool = False) -> str:
     pipeline = getattr(srv, "diagnosis", None)
     if pipeline is not None or backend is not None:
         _diagnosis_metrics(w, pipeline, backend)
+    scraper = getattr(srv, "signals", None)
+    if scraper is not None:
+        _telemetry_metrics(w, scraper)
     _tracing_metrics(w)
     _device_metrics(w)
     # Render-time self-lint: a malformed family poisons the whole scrape

@@ -75,6 +75,7 @@ class MonitorServer:
         host: str | None = None,
         port: int | None = None,
         diagnosis=None,
+        signals=None,
     ) -> None:
         self.config = config or Config()
         self.client = client
@@ -84,6 +85,11 @@ class MonitorServer:
         # loop behind GET /api/v1/diagnoses and the diagnosis_* gauges.
         # None on routers (they proxy) and in dev mode.
         self.diagnosis = diagnosis
+        # observability.signals.SignalScraper — the telemetry plane
+        # behind GET /api/v1/signals + /api/v1/timeseries; shares the
+        # server lifecycle (start/stop with the HTTP thread).  None in
+        # dev mode or when telemetry.enabled=false.
+        self.signals = signals
         self.web_dir = Path(web_dir) if web_dir else DEFAULT_WEB_DIR
         self.host = host if host is not None else self.config.server.host
         self.port = port if port is not None else self.config.server.port
@@ -207,6 +213,16 @@ class MonitorServer:
                     "entries": len(pc),
                 } if pc is not None else None,
                 "kv_tier": engine.kv_tier_stats(),
+                # Signal-scraper inputs (previously exporter-only): the
+                # fleet probes and the telemetry plane read one coherent
+                # snapshot instead of a second /metrics parse.
+                "admission_headroom_tokens":
+                    engine.admission_headroom_tokens(),
+                "shed_by_class": dict(svc.shed_count_by_class),
+                "ttft_ema_by_class": {
+                    k: round(v, 6)
+                    for k, v in engine.ttft_ema_by_class.items()},
+                "preemptions_by_class": dict(engine.preemptions_by_class),
             }
         router = self.fleet_router()
         if router is not None:
@@ -229,9 +245,13 @@ class MonitorServer:
         self._thread.start()
         if self.diagnosis is not None:
             self.diagnosis.start()
+        if self.signals is not None:
+            self.signals.start()
         logger.info("monitor server listening on %s:%d", self.host, self.port)
 
     def stop(self) -> None:
+        if self.signals is not None:
+            self.signals.stop()
         if self.diagnosis is not None:
             self.diagnosis.stop()
         if self._httpd is not None:
@@ -245,6 +265,8 @@ class MonitorServer:
     def serve_forever(self) -> None:
         if self.diagnosis is not None:
             self.diagnosis.start()
+        if self.signals is not None:
+            self.signals.start()
         handler = _make_handler(self)
         self._httpd = ThreadingHTTPServer((self.host, self.port), handler)
         self.port = self._httpd.server_address[1]
@@ -270,6 +292,8 @@ _ROUTES: dict[tuple[str, str], str] = {
     ("POST", "/api/v1/analyze"): "h_analyze",
     ("POST", "/api/v1/query"): "h_query",
     ("GET", "/api/v1/diagnoses"): "h_diagnoses",
+    ("GET", "/api/v1/signals"): "h_signals",
+    ("GET", "/api/v1/timeseries"): "h_timeseries",
     ("GET", "/api/v1/trace"): "h_trace_recent",
     ("GET", "/api/v1/metrics/cluster"): "h_metrics_cluster",
     ("GET", "/api/v1/metrics/nodes"): "h_metrics_nodes",
@@ -753,6 +777,67 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
                 "Diagnosis pipeline not available - running in development "
                 "mode", 503)
 
+        def h_signals(self) -> None:
+            """Derived autoscaler/anomaly signals from the telemetry
+            plane: fleet-merged per-replica blocks on routers, the local
+            engine block on replicas.  ``?window=N`` overrides the
+            trailing window (seconds)."""
+            scraper = srv.signals
+            if scraper is None:
+                return self._send_error_text(
+                    "Signal scraper not available - running in "
+                    "development mode", 503)
+            query = parse_qs(urlparse(self.path).query)
+            window = None
+            raw = (query.get("window", [""])[0] or "").strip()
+            if raw:
+                try:
+                    window = float(raw)
+                except ValueError:
+                    return self._send_error_text(
+                        "window must be a number of seconds", 400)
+                if window <= 0:
+                    return self._send_error_text(
+                        "window must be positive", 400)
+            payload = scraper.signals(window_s=window)
+            payload["status"] = "success"
+            payload["timestamp"] = _now()
+            self._send_json(payload)
+
+        def h_timeseries(self) -> None:
+            """Raw points of one series family for dashboards:
+            ``?name=<series>&window=N`` plus any further query params as
+            label equality filters (e.g. ``&replica=replica-0``)."""
+            scraper = srv.signals
+            if scraper is None:
+                return self._send_error_text(
+                    "Signal scraper not available - running in "
+                    "development mode", 503)
+            query = parse_qs(urlparse(self.path).query)
+            name = (query.get("name", [""])[0] or "").strip()
+            if not name:
+                return self._send_error_text("name is required", 400)
+            window = scraper.cfg.window_s
+            raw = (query.get("window", [""])[0] or "").strip()
+            if raw:
+                try:
+                    window = float(raw)
+                except ValueError:
+                    return self._send_error_text(
+                        "window must be a number of seconds", 400)
+            labels = {k: v[0] for k, v in query.items()
+                      if k not in ("name", "window") and v}
+            series = scraper.store.export(
+                name, window_s=window, label_filter=labels or None)
+            self._send_json({
+                "status": "success",
+                "name": name,
+                "window_s": window,
+                "series": series,
+                "n_series": len(series),
+                "timestamp": _now(),
+            })
+
         def _stream_query(self, question: str,
                           slo_class: str = "interactive") -> None:
             """Server-sent events: one `data:` JSON per answer-text delta as
@@ -1196,11 +1281,32 @@ def build_server(
         diagnosis = DiagnosisPipeline(
             analysis, config.diagnosis, embedder=detector,
             brownout=brownout)
-    return MonitorServer(
+    signals = None
+    if config.telemetry.enabled:
+        from k8s_llm_monitor_tpu.observability.signals import SignalScraper
+
+        # Anomaly flags feed the diagnosis pipeline's event ring as
+        # synthetic self_monitor Warnings — the monitor diagnoses its
+        # own serving stack.
+        signals = SignalScraper(cfg=config.telemetry, pipeline=diagnosis)
+    srv = MonitorServer(
         config=config,
         client=client,
         manager=manager,
         analysis=analysis,
         web_dir=web_dir,
         diagnosis=diagnosis,
+        signals=signals,
     )
+    if signals is not None:
+        signals.attach(srv)
+        # Crash-edge dumps (flight recorder v2) carry the trailing
+        # signal window: the load trajectory into the failure.
+        from k8s_llm_monitor_tpu.observability.flight import (
+            get_flight_recorder,
+        )
+
+        get_flight_recorder().signal_source = (
+            lambda: signals.store.window_snapshot(
+                config.telemetry.flight_window_s))
+    return srv

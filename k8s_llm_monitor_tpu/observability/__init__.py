@@ -1,5 +1,6 @@
-"""In-tree observability: request tracing, latency histograms, and the
-crash flight recorder (docs/observability.md).
+"""In-tree observability: request tracing, latency histograms, the
+crash flight recorder, and the fleet telemetry plane (time-series store
++ signal scraper) (docs/observability.md).
 
 Zero external dependencies.  Everything here is host-side bookkeeping —
 nothing in this package may be called from inside a traced (jitted)
@@ -19,3 +20,5 @@ from .tracing import (  # noqa: F401
 )
 from .metrics import ClassHistogram  # noqa: F401
 from .flight import FlightRecorder, get_flight_recorder  # noqa: F401
+from .timeseries import TimeSeriesStore  # noqa: F401
+from .signals import SignalScraper  # noqa: F401

@@ -22,7 +22,7 @@ import os
 import re
 import tempfile
 import time
-from typing import Any, Optional
+from typing import Any, Callable, Optional
 
 from .tracing import get_tracer
 
@@ -38,9 +38,15 @@ def _default_dir() -> str:
 
 class FlightRecorder:
     """Bounded event ring + JSON dump-on-failure.  Artifact format
-    (version 1): ``{"version", "reason", "ts_unix", "pid", "events":
+    (version 2): ``{"version", "reason", "ts_unix", "pid", "events":
     [{"t_unix", "t_mono", "kind", ...}], "spans": [span dicts],
-    "extra": {...}}``."""
+    "signals": {...} | null, "extra": {...}}``.
+
+    ``signal_source`` is an optional zero-arg callable returning a
+    JSON-safe snapshot of the local telemetry window (wired to
+    ``TimeSeriesStore.window_snapshot`` by the server builders) — the
+    load trajectory *into* the crash, alongside the event/span timeline.
+    A raising source never fails the dump."""
 
     def __init__(self, capacity: int = 512,
                  dirpath: Optional[str] = None) -> None:
@@ -51,6 +57,7 @@ class FlightRecorder:
         self.dumps = 0
         self.dump_errors = 0
         self.last_dump_path = ""
+        self.signal_source: Optional[Callable[[], Any]] = None
 
     def note(self, kind: str, **fields: Any) -> None:
         """Record one engine/service event (lock-free, bounded)."""
@@ -65,13 +72,20 @@ class FlightRecorder:
     def dump(self, reason: str, extra: Optional[dict] = None) -> str:
         """Write the artifact; returns its path ("" on I/O failure)."""
         safe = _REASON_RE.sub("_", reason)[:64] or "unknown"
+        signals = None
+        if self.signal_source is not None:
+            try:
+                signals = self.signal_source()
+            except Exception:  # noqa: BLE001 — snapshot is best-effort
+                signals = {"error": "signal snapshot failed"}
         payload = {
-            "version": 1,
+            "version": 2,
             "reason": reason,
             "ts_unix": time.time(),
             "pid": os.getpid(),
             "events": self.events(),
             "spans": get_tracer().snapshot(),
+            "signals": signals,
             "extra": extra or {},
         }
         try:

@@ -1,0 +1,668 @@
+"""Telemetry plane (observability/timeseries.py + signals.py): ring-store
+math under a fake clock, NaN marker discipline, the signal scraper's
+local-engine and fleet sampling paths, derived scale hints, the anomaly →
+diagnosis feed with its cooldown, exposition round-trips through the
+exporter self-lint, flight-recorder v2 signal windows, and the live
+2-replica flood → scale-up → anomaly → decay acceptance loop.
+
+Unit tests drive ``scrape_once()`` synchronously against fake engines and
+scripted fleet rows — no threads, no sleeps, a shared fake clock.  The
+acceptance test boots a real HTTP router fleet and is marked ``slow``;
+``make chaos-signals`` runs the whole file under ``K8SLLM_LOCKCHECK=1``.
+"""
+
+import json
+import math
+import threading
+import time
+import types
+import urllib.request
+
+import pytest
+
+import jax
+
+from k8s_llm_monitor_tpu.diagnosis.pipeline import DiagnosisPipeline
+from k8s_llm_monitor_tpu.fleet.frontend import build_router_server
+from k8s_llm_monitor_tpu.fleet.registry import ReplicaRegistry, ReplicaStats
+from k8s_llm_monitor_tpu.fleet.router import FleetRouter
+from k8s_llm_monitor_tpu.models import llama
+from k8s_llm_monitor_tpu.models.config import ModelConfig
+from k8s_llm_monitor_tpu.monitor.analysis import (
+    AnalysisEngine,
+    LocalEngineBackend,
+)
+from k8s_llm_monitor_tpu.monitor.config import (
+    Config,
+    DiagnosisConfig,
+    LLMConfig,
+    TelemetryConfig,
+)
+from k8s_llm_monitor_tpu.monitor.exporter import (
+    lint_exposition,
+    render_prometheus,
+)
+from k8s_llm_monitor_tpu.monitor.server import MonitorServer
+from k8s_llm_monitor_tpu.observability.flight import FlightRecorder
+from k8s_llm_monitor_tpu.observability.signals import (
+    LOCAL_TARGET,
+    SignalScraper,
+)
+from k8s_llm_monitor_tpu.observability.timeseries import TimeSeriesStore
+from k8s_llm_monitor_tpu.resilience.slo import SLO_CLASSES
+from k8s_llm_monitor_tpu.serving.engine import (
+    EngineConfig,
+    InferenceEngine,
+    SamplingParams,
+)
+from k8s_llm_monitor_tpu.utils.tokenizer import ByteTokenizer
+
+CFG = ModelConfig(name="t", vocab_size=300, hidden_size=32,
+                  intermediate_size=64, num_layers=2, num_heads=4,
+                  num_kv_heads=2, dtype="float32", rope_theta=10_000.0)
+
+
+@pytest.fixture(scope="module")
+def params():
+    return llama.init_params(jax.random.PRNGKey(0), CFG)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> float:
+        self.t += dt
+        return self.t
+
+
+def _wait(cond, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.01)
+    return cond()
+
+
+# ---------------------------------------------------------------------------
+# TimeSeriesStore: ring bounds, windowed math, NaN discipline
+# ---------------------------------------------------------------------------
+
+
+def test_ring_evicts_oldest_at_capacity():
+    clock = FakeClock()
+    st = TimeSeriesStore(capacity=4, clock=clock)
+    for i in range(10):
+        st.record("q", float(i), {"r": "a"}, t=float(i))
+    pts = st.points("q", {"r": "a"})
+    assert [v for _, v in pts] == [6.0, 7.0, 8.0, 9.0]
+    assert st.totals() == {"series": 1, "points_total": 10,
+                           "dropped_series_total": 0}
+
+
+def test_rate_delta_and_quantile_exact_math():
+    st = TimeSeriesStore(clock=FakeClock())
+    for t, v in [(0, 0), (1, 10), (2, 20), (3, 30)]:
+        st.record("q", v, t=float(t))
+    assert st.last("q") == 30.0
+    assert st.delta("q") == 30.0
+    assert st.rate("q") == pytest.approx(10.0)
+    assert st.quantile("q", 0.5) == pytest.approx(15.0)
+    assert st.quantile("q", 0.99) == pytest.approx(29.7)
+    assert st.quantile("q", 0.0) == 0.0
+    assert st.quantile("q", 1.0) == 30.0
+    # Degenerate windows read as NaN, never raise.
+    st2 = TimeSeriesStore(clock=FakeClock())
+    st2.record("one", 5.0, t=1.0)
+    assert math.isnan(st2.rate("one"))
+    assert math.isnan(st2.delta("one"))
+    assert math.isnan(st2.rate("missing"))
+
+
+def test_window_clips_to_trailing_seconds():
+    st = TimeSeriesStore(clock=FakeClock())
+    for t in range(10):
+        st.record("q", float(t), t=float(t))
+    pts = st.points("q", window_s=3.5, now=9.0)
+    assert [t for t, _ in pts] == [6.0, 7.0, 8.0, 9.0]
+    assert st.rate("q", window_s=3.5, now=9.0) == pytest.approx(1.0)
+    assert math.isnan(st.last("q", window_s=0.5, now=100.0))
+
+
+def test_ema_is_deterministic_hand_math():
+    def build():
+        st = TimeSeriesStore(clock=FakeClock())
+        for t, v in [(0, 0), (10, 10), (20, 20)]:
+            st.record("q", float(v), t=float(t))
+        return st
+
+    # Half-life 10 with 10 s steps halves the weight each step:
+    # 0 -> .5*0+.5*10 = 5 -> .5*5+.5*20 = 12.5.
+    assert build().ema("q", half_life_s=10.0) == pytest.approx(12.5)
+    assert build().ema("q", half_life_s=10.0) == \
+        build().ema("q", half_life_s=10.0)
+    assert math.isnan(build().ema("missing"))
+
+
+def test_nan_markers_pass_last_but_skip_window_math():
+    st = TimeSeriesStore(clock=FakeClock())
+    st.record("q", 1.0, t=0.0)
+    st.record("q", float("nan"), t=1.0)
+    st.record("q", 3.0, t=2.0)
+    assert st.last("q") == 3.0
+    st.record("q", float("nan"), t=3.0)
+    assert math.isnan(st.last("q"))            # marker passes through
+    assert st.rate("q") == pytest.approx(1.0)  # finite points only
+    assert st.delta("q") == pytest.approx(2.0)
+    assert st.quantile("q", 0.5) == pytest.approx(2.0)
+    # A junk value is recorded as the NaN marker, not an exception.
+    st.record("q", "garbage", t=4.0)
+    assert math.isnan(st.last("q"))
+
+
+def test_max_series_cap_drops_new_series_counted():
+    st = TimeSeriesStore(max_series=2, clock=FakeClock())
+    st.record("q", 1.0, {"r": "a"}, t=0.0)
+    st.record("q", 1.0, {"r": "b"}, t=0.0)
+    st.record("q", 1.0, {"r": "c"}, t=0.0)     # refused at the cap
+    st.record("q", 2.0, {"r": "a"}, t=1.0)     # existing series still fine
+    assert st.series_count() == 2
+    assert st.dropped_series_total == 1
+    assert st.last("q", {"r": "a"}) == 2.0
+    assert st.points("q", {"r": "c"}) == []
+
+
+def test_export_and_window_snapshot_are_json_safe():
+    st = TimeSeriesStore(clock=FakeClock(10.0))
+    st.record("q", 1.5, {"replica": "a", "class": "batch"}, t=1.0)
+    st.record("q", float("nan"), {"replica": "a", "class": "batch"}, t=2.0)
+    st.record("q", 7.0, {"replica": "b"}, t=2.0)
+    out = st.export("q", label_filter={"replica": "a"})
+    assert len(out) == 1
+    assert out[0]["labels"] == {"replica": "a", "class": "batch"}
+    assert out[0]["points"] == [[1.0, 1.5], [2.0, None]]
+    snap = st.window_snapshot(30.0)
+    assert snap["window_s"] == 30.0 and snap["t_mono"] == 10.0
+    assert len(snap["series"]) == 2
+    json.dumps(snap, allow_nan=False)          # strict-JSON clean
+
+
+# ---------------------------------------------------------------------------
+# SignalScraper: sampling fakes
+# ---------------------------------------------------------------------------
+
+
+class _FakeEngine:
+    """The attribute surface ``_sample_engine`` reads, all mutable."""
+
+    def __init__(self):
+        self.queue = {c: 0 for c in SLO_CLASSES}
+        self.queue_tokens = 0
+        self.ttft_ema_by_class = {}
+        self.preemptions_by_class = {"batch": 1}
+        self.active_slots = 0
+        self.headroom = 100.0
+        self.host_kv_tier = None
+        self.rung = 0
+
+    def queue_tokens_by_class(self):
+        return dict(self.queue)
+
+    def brownout(self):
+        return self.rung
+
+    def admission_headroom_tokens(self):
+        return self.headroom
+
+    def kv_tier_stats(self):
+        return {"device_bytes": 4096, "host_bytes": 0,
+                "spills": 2, "restores": 1}
+
+
+class _StubPipeline:
+    def __init__(self):
+        self.offered = []
+
+    def offer(self, event):
+        self.offered.append(event)
+
+
+def _local_scraper(eng, cfg=None, pipeline=None, clock=None):
+    clock = clock or FakeClock()
+    svc = types.SimpleNamespace(engine=eng, shed_count_by_class={"batch": 2})
+    scraper = SignalScraper(cfg=cfg or TelemetryConfig(),
+                            pipeline=pipeline, clock=clock)
+    scraper.attach(types.SimpleNamespace(engine_service=lambda: svc,
+                                         fleet_router=lambda: None))
+    return scraper, clock
+
+
+def test_scraper_samples_local_engine_catalog():
+    eng = _FakeEngine()
+    eng.queue["batch"] = 7
+    eng.queue_tokens = 7
+    eng.ttft_ema_by_class = {"interactive": 0.2}
+    eng.active_slots = 3
+    scraper, _ = _local_scraper(eng)
+    scraper.scrape_once()
+    st, lab = scraper.store, {"replica": LOCAL_TARGET}
+    assert st.last("queue_tokens",
+                   {"replica": LOCAL_TARGET, "class": "batch"}) == 7.0
+    assert st.last("queue_tokens_total", lab) == 7.0
+    assert st.last("ttft_ema_s",
+                   {"replica": LOCAL_TARGET,
+                    "class": "interactive"}) == pytest.approx(0.2)
+    assert math.isnan(st.last("ttft_ema_s",
+                              {"replica": LOCAL_TARGET, "class": "batch"}))
+    assert st.last("headroom_tokens", lab) == 100.0
+    assert st.last("busy_slots", lab) == 3.0
+    assert st.last("kv_bytes",
+                   {"replica": LOCAL_TARGET, "tier": "device"}) == 4096.0
+    # No host tier wired: occupancy is unmeasured, not zero.
+    assert math.isnan(st.last("kv_bytes",
+                              {"replica": LOCAL_TARGET, "tier": "host"}))
+    assert st.last("kv_spills_total", lab) == 2.0
+    assert st.last("sheds_total",
+                   {"replica": LOCAL_TARGET, "class": "batch"}) == 2.0
+    assert st.last("preemptions_total",
+                   {"replica": LOCAL_TARGET, "class": "batch"}) == 1.0
+    assert scraper.counters()["scrapes_total"] == 1
+    assert scraper.role() == "replica"
+
+
+def test_scrape_failure_is_a_counter_not_an_outage():
+    def boom():
+        raise RuntimeError("engine gone")
+
+    scraper = SignalScraper(cfg=TelemetryConfig(), clock=FakeClock())
+    scraper.attach(types.SimpleNamespace(engine_service=boom,
+                                         fleet_router=lambda: None))
+    scraper.scrape_once()                      # must not raise
+    c = scraper.counters()
+    assert c["scrape_errors_total"] == 1 and c["scrapes_total"] == 0
+
+
+def _fleet_rows(**ages):
+    """Scripted registry snapshot rows, one per replica id -> probe age."""
+    rows = {}
+    for rid, age in ages.items():
+        rows[rid] = {
+            "probe_age_s": age,
+            "queue_tokens": 40,
+            "queue_by_class": {"batch": 40},
+            "ttft_ema_by_class": {"interactive": 0.1},
+            "preemptions_by_class": {},
+            "shed_by_class": {},
+            "brownout": 0,
+            "busy_slots": 2,
+            "headroom_tokens": 64.0,
+            "kv_tier": {"device_bytes": 1024, "host_bytes": 0,
+                        "spills": 0, "restores": 0},
+        }
+    return rows
+
+
+def test_stale_fleet_rows_record_nan_never_frozen_values():
+    clock = FakeClock()
+    pipe = _StubPipeline()
+    cfg = TelemetryConfig(stale_after_probes=3.0, anomaly_cooldown_s=30.0)
+    scraper = SignalScraper(cfg=cfg, pipeline=pipe, clock=clock)
+    rows = _fleet_rows(r0=0.1, r1=10.0, r2=None)   # fresh / stale / never
+    router = types.SimpleNamespace(telemetry_sample=lambda: {
+        "replicas": rows, "probe_interval_s": 0.5, "counters": {}})
+    scraper.attach(types.SimpleNamespace(engine_service=lambda: None,
+                                         fleet_router=lambda: router))
+    scraper.scrape_once()
+    st = scraper.store
+    assert st.last("queue_tokens_total", {"replica": "r0"}) == 40.0
+    for rid in ("r1", "r2"):
+        assert math.isnan(st.last("queue_tokens_total", {"replica": rid}))
+        assert math.isnan(st.last("headroom_tokens", {"replica": rid}))
+        assert math.isnan(st.last(
+            "queue_tokens", {"replica": rid, "class": "batch"}))
+    assert math.isnan(st.last("scrape_age_s", {"replica": "r2"}))
+    assert st.last("scrape_age_s", {"replica": "r1"}) == 10.0
+
+    payload = scraper.signals()
+    assert payload["role"] == "router"
+    assert payload["targets"]["r0"]["stale"] is False
+    for rid in ("r1", "r2"):
+        blk = payload["targets"][rid]
+        assert blk["stale"] is True
+        assert blk["scale_hint"] == "steady"   # never scale on no evidence
+        assert "scrape_stale" in blk["anomalies"]
+        assert blk["queue_tokens_total"] is None
+    json.dumps(payload, allow_nan=False)
+    # Both stale targets fed the diagnosis ring as self_monitor Warnings.
+    reasons = {(e.reason, e.type, e.source) for e in pipe.offered}
+    assert ("SelfMonitor:scrape_stale", "Warning", "self_monitor") in reasons
+    assert len([e for e in pipe.offered
+                if e.reason == "SelfMonitor:scrape_stale"]) == 2
+
+
+# ---------------------------------------------------------------------------
+# Derived signals: scale hints + anomaly feed
+# ---------------------------------------------------------------------------
+
+
+def test_queue_growth_drives_scale_up_and_anomaly_with_cooldown():
+    eng = _FakeEngine()
+    pipe = _StubPipeline()
+    cfg = TelemetryConfig(queue_growth_up_tok_s=5.0, anomaly_cooldown_s=30.0)
+    scraper, clock = _local_scraper(eng, cfg=cfg, pipeline=pipe)
+    for q in (0, 100, 200, 300):
+        eng.queue["batch"] = q
+        eng.queue_tokens = q
+        scraper.scrape_once()
+        clock.advance(1.0)
+    blk = scraper.signals()["targets"][LOCAL_TARGET]
+    assert blk["scale_hint"] == "up"
+    assert "queue_growth" in blk["anomalies"]
+    assert blk["queue_growth_tok_per_s"]["batch"] == pytest.approx(100.0)
+    assert blk["queue_growth_total_tok_per_s"] == pytest.approx(100.0)
+
+    growth = [e for e in pipe.offered
+              if e.reason == "SelfMonitor:queue_growth"]
+    assert len(growth) == 1                    # edge-triggered once
+    assert growth[0].type == "Warning"
+    assert growth[0].source == "self_monitor"
+    assert "tok/s" in growth[0].message
+
+    # Still growing inside the cooldown: suppressed.
+    for q in (400, 500):
+        eng.queue["batch"] = q
+        eng.queue_tokens = q
+        scraper.scrape_once()
+        clock.advance(1.0)
+    assert len([e for e in pipe.offered
+                if e.reason == "SelfMonitor:queue_growth"]) == 1
+    # Past the cooldown with growth persisting: re-emitted.
+    clock.advance(31.0)
+    for q in (600, 700, 800):
+        eng.queue["batch"] = q
+        eng.queue_tokens = q
+        scraper.scrape_once()
+        clock.advance(1.0)
+    assert len([e for e in pipe.offered
+                if e.reason == "SelfMonitor:queue_growth"]) == 2
+    by_flag = scraper.counters()["anomalies_by_flag"]
+    assert by_flag["queue_growth"] == 2
+    assert any(a["flag"] == "queue_growth"
+               for a in scraper.signals()["recent_anomalies"])
+
+
+def test_idle_window_with_headroom_reads_scale_down():
+    eng = _FakeEngine()                        # all-zero queues, rung 0
+    scraper, clock = _local_scraper(eng)
+    for _ in range(4):
+        scraper.scrape_once()
+        clock.advance(1.0)
+    blk = scraper.signals()["targets"][LOCAL_TARGET]
+    assert blk["scale_hint"] == "down"
+    assert blk["anomalies"] == []
+    assert blk["brownout_dwell"] == 0.0
+
+
+def test_sustained_ttft_breach_flags_and_scales_up():
+    eng = _FakeEngine()
+    eng.ttft_ema_by_class = {"interactive": 2.0}   # budget is 1.0 s
+    pipe = _StubPipeline()
+    scraper, clock = _local_scraper(eng, pipeline=pipe)
+    for _ in range(3):
+        scraper.scrape_once()
+        clock.advance(1.0)
+    blk = scraper.signals()["targets"][LOCAL_TARGET]
+    assert blk["scale_hint"] == "up"
+    assert "ttft_breach" in blk["anomalies"]
+    assert blk["ttft_budget_breach"]["interactive"] is True
+    assert any(e.reason == "SelfMonitor:ttft_breach" for e in pipe.offered)
+    # A falling EMA is recovery, not a sustained breach.
+    eng2 = _FakeEngine()
+    scraper2, clock2 = _local_scraper(eng2)
+    for v in (3.0, 2.0, 1.2):
+        eng2.ttft_ema_by_class = {"interactive": v}
+        scraper2.scrape_once()
+        clock2.advance(1.0)
+    blk2 = scraper2.signals()["targets"][LOCAL_TARGET]
+    assert blk2["ttft_budget_breach"]["interactive"] is False
+    assert "ttft_breach" not in blk2["anomalies"]
+
+
+def test_brownout_dwell_drives_scale_up():
+    eng = _FakeEngine()
+    scraper, clock = _local_scraper(eng)
+    for rung in (1, 1, 1, 0):                  # 75% of window at >= degraded
+        eng.rung = rung
+        scraper.scrape_once()
+        clock.advance(1.0)
+    blk = scraper.signals()["targets"][LOCAL_TARGET]
+    assert blk["brownout_dwell"] == pytest.approx(0.75)
+    assert blk["scale_hint"] == "up"
+
+
+# ---------------------------------------------------------------------------
+# Wire formats: stats payload, exposition, flight artifact
+# ---------------------------------------------------------------------------
+
+
+def test_replica_stats_from_payload_round_trips_enriched_block():
+    payload = {"engine": {
+        "queue_depth": 3, "queue_tokens": 120, "busy_slots": 2,
+        "total_slots": 4, "brownout": 1,
+        "queue_tokens_by_class": {"batch": 120},
+        "prefix_cache": {"hits": 5, "misses": 1},
+        "kv_tier": {"device_bytes": 2048, "spills": 7},
+        "admission_headroom_tokens": 88.5,
+        "shed_by_class": {"batch": 9},
+        "ttft_ema_by_class": {"interactive": 0.125},
+        "preemptions_by_class": {"standard": 2},
+    }}
+    s = ReplicaStats.from_payload(payload)
+    assert s.queue_tokens == 120 and s.brownout == 1
+    assert s.headroom_tokens == pytest.approx(88.5)
+    assert s.shed_by_class == {"batch": 9}
+    assert s.ttft_ema_by_class == {"interactive": 0.125}
+    assert s.preemptions_by_class == {"standard": 2}
+    assert s.kv_tier["spills"] == 7
+    # Absent enrichment stays None/empty — never invented zeros that
+    # would read as measurements.
+    bare = ReplicaStats.from_payload({"engine": {"total_slots": 4}})
+    assert bare.headroom_tokens is None
+    assert bare.shed_by_class == {} and bare.ttft_ema_by_class == {}
+
+
+class _ProbeReplica:
+    replica_id = "a"
+
+    def readyz(self):
+        return True
+
+    def stats(self):
+        return ReplicaStats(total_slots=4, queue_tokens=10)
+
+    def close(self):
+        pass
+
+
+def test_exposition_carries_fleet_age_and_telemetry_families():
+    reg = ReplicaRegistry()
+    reg.add(_ProbeReplica())
+    reg.refresh()
+    router = FleetRouter(reg)
+    scraper = SignalScraper(cfg=TelemetryConfig(), clock=FakeClock())
+    scraper.attach(types.SimpleNamespace(
+        engine_service=lambda: None,
+        fleet_router=lambda: types.SimpleNamespace(
+            telemetry_sample=lambda: {"replicas": reg.snapshot(),
+                                      "probe_interval_s": 5.0,
+                                      "counters": {}})))
+    scraper.scrape_once()
+    srv = types.SimpleNamespace(
+        analysis=types.SimpleNamespace(router=router, backend=None),
+        client=None, manager=None, diagnosis=None, signals=scraper)
+    text = render_prometheus(srv)
+    assert lint_exposition(text) == []
+    assert 'k8s_llm_monitor_fleet_scrape_age_s{replica="a"}' in text
+    for fam in ("telemetry_scrapes_total", "telemetry_scrape_errors_total",
+                "telemetry_anomalies_total", "telemetry_series",
+                "telemetry_points_total", "telemetry_dropped_series_total"):
+        assert f"k8s_llm_monitor_{fam}" in text, fam
+
+
+def test_flight_recorder_v2_carries_signal_window(tmp_path):
+    clock = FakeClock(50.0)
+    store = TimeSeriesStore(clock=clock)
+    store.record("queue_tokens_total", 5.0, {"replica": "local"}, t=49.0)
+    store.record("queue_tokens_total", float("nan"),
+                 {"replica": "local"}, t=50.0)
+    rec = FlightRecorder(capacity=8, dirpath=str(tmp_path))
+    rec.signal_source = lambda: store.window_snapshot(30.0)
+    rec.note("tick")
+    art = json.loads(open(rec.dump("telemetry window")).read())
+    assert art["version"] == 2
+    series = art["signals"]["series"]
+    assert len(series) == 1
+    assert series[0]["name"] == "queue_tokens_total"
+    assert series[0]["points"] == [[49.0, 5.0], [50.0, None]]
+
+
+# ---------------------------------------------------------------------------
+# Acceptance: live 2-replica fleet, flood -> scale-up -> anomaly -> decay
+# ---------------------------------------------------------------------------
+
+
+class _NullAnalysis:
+    def diagnose(self, question, context=""):
+        return {"verdict": {}}
+
+
+def _boot_replica(params):
+    tok = ByteTokenizer()
+    engine = InferenceEngine(
+        CFG, params,
+        EngineConfig(max_slots=4, num_blocks=256, block_size=16,
+                     max_blocks_per_seq=8, prefill_buckets=(32,),
+                     max_prefills_per_step=4, decode_steps_per_iter=4,
+                     prefix_cache_entries=0),
+        tokenizer=tok)
+    backend = LocalEngineBackend(engine, tok)
+    analysis = AnalysisEngine(backend, llm_cfg=LLMConfig(max_tokens=16))
+    srv = MonitorServer(config=Config(), analysis=analysis, port=0)
+    srv.start()
+    return srv, backend
+
+
+def _get_json(url, timeout=30):
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+@pytest.mark.chaos
+@pytest.mark.slow  # boots a 2-engine HTTP fleet; covered by chaos-signals
+def test_live_fleet_flood_scale_up_anomaly_then_decay(params):
+    """The ISSUE acceptance gate: flood one replica with batch traffic;
+    within a scrape interval or two the router's /api/v1/signals must show
+    positive queue-token growth and a scale-up hint for that replica, a
+    self_monitor anomaly must land in the diagnosis pipeline (trigger
+    counter), and after the backlog drains the hint decays off "up"."""
+    reps = [_boot_replica(params) for _ in range(2)]
+    cfg = Config()
+    cfg.server.port = 0
+    cfg.fleet.replicas = [f"http://127.0.0.1:{srv.port}" for srv, _ in reps]
+    cfg.fleet.probe_interval_s = 0.25
+    cfg.telemetry.scrape_interval_s = 0.25
+    cfg.telemetry.window_s = 6.0
+    cfg.telemetry.queue_growth_up_tok_s = 5.0
+    cfg.telemetry.anomaly_cooldown_s = 600.0
+    # Generous staleness budget: a loaded CI box can starve the probe
+    # thread, and a spurious stale flag would force hint=steady.
+    cfg.telemetry.stale_after_probes = 60.0
+    router_srv = build_router_server(cfg)
+    # Router-role self-diagnosis: the builder leaves the pipeline to the
+    # caller (see build_router_server); one Warning = one trigger here.
+    pipe = DiagnosisPipeline(
+        _NullAnalysis(),
+        DiagnosisConfig(burst_threshold=1, cooldown_s=0.0))
+    router_srv.signals.pipeline = pipe
+    router_srv.start()
+    base = f"http://127.0.0.1:{router_srv.port}"
+
+    victim_svc = reps[0][1].service
+    handles, stop_feed = [], threading.Event()
+
+    def _feeder():
+        i = 0
+        while not stop_feed.is_set() and len(handles) < 900:
+            for _ in range(4):
+                prompt = [(i * 7 + j) % 290 + 3 for j in range(16)]
+                handles.append(victim_svc.submit(
+                    prompt, SamplingParams(max_tokens=2),
+                    force=True, slo_class="batch"))
+                i += 1
+            time.sleep(0.04)
+
+    feeder = threading.Thread(target=_feeder, daemon=True)
+    feeder.start()
+    try:
+        def _victim_block():
+            payload = _get_json(f"{base}/api/v1/signals")
+            return payload["targets"].get("replica-0")
+
+        def _scaled_up():
+            blk = _victim_block()
+            return (blk is not None and blk["scale_hint"] == "up"
+                    and (blk["queue_growth_total_tok_per_s"] or 0) > 0)
+
+        assert _wait(_scaled_up, timeout=30), _victim_block()
+        # The monotonic-growth anomaly fired and reached the diagnosis
+        # pipeline as a self_monitor Warning -> one burst trigger.
+        assert _wait(lambda: router_srv.signals.counters()
+                     ["anomalies_by_flag"].get("queue_growth", 0) >= 1,
+                     timeout=30)
+        assert _wait(lambda: pipe.triggers_total >= 1, timeout=15)
+        assert any(a["flag"] == "queue_growth" and a["target"] == "replica-0"
+                   for a in router_srv.signals.signals()["recent_anomalies"])
+
+        # Satellite 1: the replica's enriched /api/v1/stats block — the
+        # registry probe rows the router-side series were built from.
+        eng_blk = _get_json(
+            f"http://127.0.0.1:{reps[0][0].port}/api/v1/stats")["engine"]
+        for key in ("admission_headroom_tokens", "kv_tier", "shed_by_class",
+                    "ttft_ema_by_class", "preemptions_by_class",
+                    "queue_tokens_by_class"):
+            assert key in eng_blk, key
+
+        # Raw points behind the hint, filtered by replica label.
+        ts = _get_json(f"{base}/api/v1/timeseries"
+                       "?name=queue_tokens_total&replica=replica-0")
+        assert ts["n_series"] == 1
+        assert len(ts["series"][0]["points"]) >= 2
+
+        stop_feed.set()
+        feeder.join(timeout=10)
+        for h in list(handles):
+            res = h.result(timeout=180)
+            assert res.finish_reason in ("length", "eos"), res.error
+
+        # Drained: over a short fresh window the hint decays off "up".
+        def _decayed():
+            payload = _get_json(f"{base}/api/v1/signals?window=3")
+            blk = payload["targets"].get("replica-0")
+            return (blk is not None and blk["scale_hint"] != "up"
+                    and (blk["queue_tokens_total"] or 0) == 0)
+
+        assert _wait(_decayed, timeout=60)
+    finally:
+        stop_feed.set()
+        router_srv.analysis.close()
+        router_srv.stop()
+        for srv, backend in reps:
+            srv.stop()
+            try:
+                backend.service.stop(timeout=5.0)
+            except Exception:  # noqa: BLE001 — teardown best-effort
+                pass

@@ -264,7 +264,8 @@ def test_flight_recorder_artifact_format(tmp_path):
     assert path and rec.dumps == 1 and rec.last_dump_path == path
     assert "watchdog" in path and "!" not in path    # reason sanitized
     art = json.loads(open(path).read())
-    assert art["version"] == 1
+    assert art["version"] == 2
+    assert art["signals"] is None    # no telemetry source wired here
     assert art["reason"] == "watchdog: decode stuck!"
     assert art["extra"] == {"k": "v"}
     assert len(art["events"]) == 32                  # ring bounded
