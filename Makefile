@@ -7,9 +7,10 @@ SHELL := /bin/bash    # tier1 uses pipefail/PIPESTATUS
 
 .PHONY: run run-agent run-scheduler demo test test-fast tier1 tier1-mesh \
         chaos chaos-lifecycle chaos-fleet chaos-overload chaos-kvtier \
-        chaos-trace chaos-signals \
+        chaos-trace chaos-signals chaos-elastic \
         diagnose-e2e bench bench-decode \
-        bench-fleet bench-mesh bench-signals dryrun smoke preflight \
+        bench-fleet bench-mesh bench-signals bench-elastic dryrun smoke \
+        preflight \
         deploy-agent docker \
         docker-agent docker-scheduler lint lint-trace clean
 
@@ -102,6 +103,17 @@ chaos-signals:
 	$(TEST_ENV) K8SLLM_LOCKCHECK=1 \
 	  $(PY) -m pytest tests/test_signals.py -q -p no:cacheprovider
 
+# Disaggregated-fleet + elasticity acceptance (docs/fleet.md
+# "Disaggregated roles & autoscaling"): the prefill→decode handoff ladder
+# (every install failure degrades to local decode, byte-exact), drain
+# lifecycle with the budget-bounded prefix sweep, AutoscaleController
+# hysteresis gates under a fake clock, and the 2-prefill/2-decode
+# chaos burst with scale-up + drain-down + rebalance mid-burst — with
+# lock discipline checked.
+chaos-elastic:
+	$(TEST_ENV) K8SLLM_LOCKCHECK=1 \
+	  $(PY) -m pytest tests/test_elasticity.py -q -p no:cacheprovider
+
 # Diagnosis acceptance (docs/diagnosis.md): grammar compiler units, the
 # constrained-sampling fuzz (every sample parses), and the synthetic
 # crash-loop burst → verdict e2e — with lock discipline checked.
@@ -137,6 +149,13 @@ bench-mesh:
 bench-signals:
 	$(TEST_ENV) BENCH_SIGNALS_ONLY=1 BENCH_MODEL=tiny BENCH_QUANT=none \
 	  $(PY) bench.py | tee signals-bench.json
+
+# Elasticity reaction smoke: reaction time from hint to first scale-up,
+# TTFT p99 churn-vs-steady ratio, and the handoff-vs-local-prefill TTFT
+# ratio on a tiny CPU fleet.
+bench-elastic:
+	$(TEST_ENV) BENCH_ELASTIC_ONLY=1 BENCH_MODEL=tiny BENCH_QUANT=none \
+	  $(PY) bench.py | tee elastic-bench.json
 
 smoke:              # boot server + 20-check live API suite
 	$(TEST_ENV) bash scripts/smoke.sh

@@ -579,6 +579,271 @@ def signals_leg(cfg, params) -> dict:
     }
 
 
+def elasticity_leg(cfg, params) -> dict:
+    """Disaggregated-fleet elasticity smoke (fleet/autoscaler.py +
+    docs/fleet.md "Disaggregated roles & autoscaling").  Three numbers:
+
+    - reaction: wall time from a scale_hint flipping "up" to the
+      controller invoking the executor (sense→decide through the gate
+      ladder), with the warm-spawn time reported separately — replica
+      cold-start dominates real reaction and deserves its own line.
+    - churn-vs-steady TTFT p99: the identical burst with the controller
+      idle vs with a scale-up AND a drain-based scale-down landing
+      mid-burst.  Elasticity must not wreck the interactive tail.
+    - handoff-vs-local-prefill TTFT: first-token latency continuing a
+      prompt whose KV prefix was exported/installed (suffix-only prefill)
+      vs re-prefilling the same prompt cold.  The point of shipping KV is
+      that this ratio stays <= 0.5x — asserted.
+    """
+    import threading
+
+    import numpy as np
+
+    from k8s_llm_monitor_tpu.fleet import (
+        AutoscaleController,
+        FleetRouter,
+        LocalPoolExecutor,
+        LocalReplica,
+        ReplicaRegistry,
+    )
+    from k8s_llm_monitor_tpu.monitor.config import AutoscaleConfig
+    from k8s_llm_monitor_tpu.serving.engine import (
+        EngineConfig,
+        InferenceEngine,
+        SamplingParams,
+    )
+    from k8s_llm_monitor_tpu.serving.service import EngineService
+
+    e_len = int(os.environ.get("BENCH_ELASTIC_PROMPT_LEN", "64"))
+    e_gen = int(os.environ.get("BENCH_ELASTIC_MAX_TOKENS", "8"))
+    e_n = int(os.environ.get("BENCH_ELASTIC_CONCURRENCY", "12"))
+    seq_blocks = (e_len + e_gen) // 8 + 4
+    ecfg = EngineConfig(
+        max_slots=4,
+        num_blocks=8 * seq_blocks + 16,
+        block_size=8,
+        max_blocks_per_seq=seq_blocks,
+        prefill_buckets=(16, e_len),
+        max_prefills_per_step=4,
+        decode_steps_per_iter=4,
+    )
+    rng = np.random.default_rng(31)
+
+    def rand_prompt(n):
+        return [int(t) for t in rng.integers(4, cfg.vocab_size - 4, size=n)]
+
+    def warm(rep):
+        # Compile the full-prefill AND the suffix-only prefill path (what
+        # a handoff continuation runs) before any measured dispatch.
+        w = rand_prompt(e_len)
+        first = rep.generate(w, SamplingParams(max_tokens=2)).result(
+            timeout=600.0)
+        rep.generate(w + first.token_ids[:1],
+                     SamplingParams(max_tokens=2)).result(timeout=600.0)
+
+    def new_replica(role, rid):
+        eng = InferenceEngine(cfg, params, ecfg, eos_id=-1)
+        rep = LocalReplica(rid, service=EngineService(eng), role=role)
+        warm(rep)
+        return rep
+
+    reg = ReplicaRegistry()
+    reps = [new_replica("prefill", "prefill-0"),
+            new_replica("decode", "decode-0")]
+    for r in reps:
+        reg.add(r)
+    reg.refresh()
+    router = FleetRouter(reg, policy="affinity", affinity_prefix_tokens=16)
+
+    closers = list(reps)
+
+    def burst(mid=None):
+        recs = []
+        for _ in range(e_n):
+            p = rand_prompt(e_len)
+            t0 = time.monotonic()
+            recs.append((t0, router.submit(p,
+                                           SamplingParams(max_tokens=e_gen))))
+        if mid is not None:
+            mid()
+        lat: list[float] = []
+
+        def consume(t0, h):
+            it = h.stream(timeout=600.0)
+            next(it)
+            dt = time.monotonic() - t0
+            for _ in it:
+                pass
+            res = h.result(timeout=600.0)
+            assert res.finish_reason == "length", res.error
+            lat.append(dt)
+
+        threads = [threading.Thread(target=consume, args=rec, daemon=True)
+                   for rec in recs]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=600.0)
+        assert len(lat) == e_n
+        lat.sort()
+        return lat
+
+    steady = burst()
+
+    # -- elasticity mid-burst: scale-up, then drain-based scale-down ------
+    sig_targets: dict = {}
+
+    class _Sig:
+        def signals(self):
+            return {"targets": dict(sig_targets)}
+
+    decided: dict = {}
+
+    class _TimedPool(LocalPoolExecutor):
+        def scale(self, role, replicas, dry_run=False):
+            if not dry_run and "t" not in decided:
+                decided["t"] = time.monotonic()
+            return super().scale(role, replicas, dry_run)
+
+    def tracked_factory(role, rid):
+        rep = new_replica(role, rid)
+        closers.append(rep)
+        return rep
+
+    executor = _TimedPool(reg, tracked_factory)
+    for r in reps:
+        executor.adopt(r.role, r)
+    ctl = AutoscaleController(
+        _Sig(), executor,
+        AutoscaleConfig(enabled=True, cooldown_s=0.05,
+                        scale_down_dwell_s=0.2, min_prefill=1, max_prefill=2,
+                        min_decode=1, max_decode=3, flap_max_flips=50),
+        registry=reg)
+    reaction: dict = {}
+
+    def churn():
+        t0 = time.monotonic()
+        sig_targets["decode-0"] = {"scale_hint": "up",
+                                   "anomalies": ["queue_growth"],
+                                   "stale": False}
+        deadline = t0 + 120.0
+        while (("decode", "up", "applied") not in ctl.actions_total
+               and time.monotonic() < deadline):
+            ctl.tick()
+            time.sleep(0.01)
+        assert ("decode", "up", "applied") in ctl.actions_total
+        reaction["decide_s"] = decided["t"] - t0
+        reaction["spawn_s"] = time.monotonic() - t0
+        sig_targets["decode-0"] = {"scale_hint": "down", "anomalies": [],
+                                   "stale": False}
+        deadline = time.monotonic() + 120.0
+        while (("decode", "down", "applied") not in ctl.actions_total
+               and time.monotonic() < deadline):
+            ctl.tick()
+            time.sleep(0.02)
+
+    churn_lat = burst(mid=churn)
+    executor.reap()
+
+    def pct(sorted_lat, q):
+        return sorted_lat[min(len(sorted_lat) - 1,
+                              int(len(sorted_lat) * q))]
+
+    steady_p99 = pct(steady, 0.99)
+    churn_p99 = pct(churn_lat, 0.99)
+    churn_ratio = churn_p99 / steady_p99 if steady_p99 > 0 else 0.0
+
+    # -- handoff vs cold-prefill TTFT (replica level, best-of-3) ----------
+    # Long prompt on purpose: the ratio is only meaningful once prefill
+    # compute dominates the fixed per-dispatch engine-loop cost (~10 ms
+    # on CPU) — at diagnosis-prompt sizes the gap is far larger still.
+    h_len = int(os.environ.get("BENCH_ELASTIC_HANDOFF_PROMPT_LEN", "1024"))
+    # The prefix cache publishes whole blocks only and always keeps the
+    # final prompt token unshared (kv_cache.shareable_blocks), so a
+    # block-aligned owner prompt caches one block short and leaves the
+    # continuation a (block_size + 1)-token suffix — just past the small
+    # prefill bucket, i.e. full-prefill cost.  Snap to one token below
+    # alignment: the continuation then carries exactly one bucket-16
+    # suffix beyond the shipped prefix.
+    h_len = max(256, h_len // 16 * 16) - 1
+    h_blocks = h_len // 16 + 4
+    hcfg = EngineConfig(
+        max_slots=2,
+        num_blocks=5 * h_blocks + 16,  # 4 pinned prefixes + an active seq
+        block_size=16,
+        max_blocks_per_seq=h_blocks,
+        prefill_buckets=(16, h_len + 64),
+        max_prefills_per_step=2,
+        decode_steps_per_iter=4,
+    )
+
+    def h_rep(rid):
+        eng = InferenceEngine(cfg, params, hcfg, eos_id=-1)
+        rep = LocalReplica(rid, service=EngineService(eng), role="unified")
+        w = rand_prompt(h_len)
+        first = rep.generate(w, SamplingParams(max_tokens=2)).result(
+            timeout=600.0)
+        rep.generate(w + first.token_ids[:1],
+                     SamplingParams(max_tokens=2)).result(timeout=600.0)
+        closers.append(rep)
+        return rep
+
+    owner, target, cold = h_rep("h-owner"), h_rep("h-target"), h_rep("h-cold")
+
+    def ttft_once(rep, prompt):
+        t0 = time.monotonic()
+        h = rep.generate(prompt, SamplingParams(max_tokens=2))
+        it = h.stream(timeout=600.0)
+        next(it)
+        dt = time.monotonic() - t0
+        for _ in it:
+            pass
+        h.result(timeout=600.0)
+        return dt
+
+    handoff_ts, cold_ts = [], []
+    for _ in range(3):
+        p = rand_prompt(h_len)
+        first = owner.generate(p, SamplingParams(max_tokens=1)).result(
+            timeout=600.0)
+        cont = p + first.token_ids[:1]
+        blob = owner.fetch_prefix(cont)
+        assert blob is not None, "owner exported no prefix"
+        outcome = target.install_prefix(blob)
+        assert outcome in ("installed", "cached"), outcome
+        handoff_ts.append(ttft_once(target, cont))
+        cold_ts.append(ttft_once(cold, cont))
+    handoff_ttft, cold_ttft = min(handoff_ts), min(cold_ts)
+    handoff_ratio = handoff_ttft / cold_ttft if cold_ttft > 0 else 0.0
+
+    for rep in closers:
+        rep.close()
+
+    actions = {"/".join(k): v for k, v in sorted(ctl.actions_total.items())}
+    log(f"elastic: decide {reaction['decide_s'] * 1e3:.1f} ms, warm spawn "
+        f"{reaction['spawn_s']:.2f} s; TTFT p99 churn {churn_p99 * 1e3:.1f} "
+        f"ms vs steady {steady_p99 * 1e3:.1f} ms ({churn_ratio:.2f}x); "
+        f"handoff TTFT {handoff_ttft * 1e3:.1f} ms vs cold prefill "
+        f"{cold_ttft * 1e3:.1f} ms ({handoff_ratio:.2f}x, budget <= 0.5x)")
+    assert handoff_ratio <= 0.5, (
+        f"handoff continuation TTFT {handoff_ttft * 1e3:.1f} ms is "
+        f"{handoff_ratio:.2f}x a cold prefill ({cold_ttft * 1e3:.1f} ms); "
+        "shipping the KV prefix should at least halve it")
+    return {
+        "elastic_reaction_decide_ms": round(reaction["decide_s"] * 1e3, 2),
+        "elastic_reaction_spawn_s": round(reaction["spawn_s"], 2),
+        "elastic_steady_ttft_p50_ms": round(pct(steady, 0.5) * 1e3, 1),
+        "elastic_steady_ttft_p99_ms": round(steady_p99 * 1e3, 1),
+        "elastic_churn_ttft_p99_ms": round(churn_p99 * 1e3, 1),
+        "elastic_churn_vs_steady_p99": round(churn_ratio, 2),
+        "elastic_handoff_ttft_ms": round(handoff_ttft * 1e3, 2),
+        "elastic_cold_prefill_ttft_ms": round(cold_ttft * 1e3, 2),
+        "elastic_handoff_vs_local_ttft": round(handoff_ratio, 3),
+        "elastic_handoff_budget": 0.5,
+        "elastic_autoscale_actions": actions,
+    }
+
+
 def mesh_leg(cfg, params) -> dict:
     """ICI-sharded serving leg: ONE tensor-parallel engine over every local
     device (weights column/row-sharded, KV pages head-sharded — parallel/
@@ -948,6 +1213,19 @@ def main() -> None:
             "metric": "signals_overhead_pct",
             "value": stats.get("signals_overhead_pct", 0.0),
             "unit": "%",
+            "extras": {"model": model_name, "platform": dev.platform,
+                       **stats},
+        }))
+        return
+
+    if os.environ.get("BENCH_ELASTIC_ONLY", "0") == "1":
+        # `make bench-elastic`: scale-up reaction time, churn-vs-steady
+        # TTFT tail, and the handoff-vs-cold-prefill ratio (budget 0.5x).
+        stats = elasticity_leg(cfg, params)
+        print(json.dumps({
+            "metric": "elastic_handoff_vs_local_ttft",
+            "value": stats.get("elastic_handoff_vs_local_ttft", 0.0),
+            "unit": "x",
             "extras": {"model": model_name, "platform": dev.platform,
                        **stats},
         }))
@@ -2179,6 +2457,15 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001 — extras never fail the bench
         log(f"signals overhead leg skipped: {exc}")
 
+    elastic_stats: dict = {}
+    try:
+        if os.environ.get("BENCH_ELASTIC", "1") == "1":
+            elastic_stats = elasticity_leg(cfg, params)
+    except AssertionError:
+        raise  # a blown handoff-TTFT budget IS a bench failure
+    except Exception as exc:  # noqa: BLE001 — extras never fail the bench
+        log(f"elasticity leg skipped: {exc}")
+
     extras = {
         "model": model_name,
         "quant": quant,
@@ -2304,6 +2591,7 @@ def main() -> None:
     extras.update(migration_stats)
     extras.update(tracing_stats)
     extras.update(signals_stats)
+    extras.update(elastic_stats)
     log(f"total bench time {time.monotonic() - t0:.0f}s")
     print(json.dumps({
         "metric": "p50_ttft_100c_ms",

@@ -41,6 +41,9 @@ def _graceful_shutdown(srv, grace_s: float, log: logging.Logger) -> None:
     rec = get_flight_recorder()
     rec.note("sigterm", grace_s=grace_s)
     rec.dump("sigterm", extra={"grace_s": grace_s})
+    # Announce draining FIRST: the next fleet stats probe sees it and the
+    # router stops dispatching here before the engine starts refusing.
+    srv.draining = True
     if srv.signals is not None:
         srv.signals.stop()
         log.info("signal scraper stopped")
@@ -127,6 +130,8 @@ def main(argv: list[str] | None = None) -> int:
         from k8s_llm_monitor_tpu.fleet.frontend import build_router_server
 
         srv = build_router_server(config)
+        if srv.autoscaler is not None:
+            srv.autoscaler.start()
         shutdown_started = threading.Event()
 
         def _on_router_signal(signum, frame):  # noqa: ARG001 — signal API
@@ -141,6 +146,8 @@ def main(argv: list[str] | None = None) -> int:
 
                 get_flight_recorder().dump("sigterm",
                                            extra={"role": "router"})
+                if srv.autoscaler is not None:
+                    srv.autoscaler.stop()
                 if srv.signals is not None:
                     srv.signals.stop()
                 srv.analysis.close()
@@ -155,6 +162,8 @@ def main(argv: list[str] | None = None) -> int:
             srv.serve_forever()
         finally:
             if not shutdown_started.is_set():
+                if srv.autoscaler is not None:
+                    srv.autoscaler.stop()
                 if srv.signals is not None:
                     srv.signals.stop()
                 srv.analysis.close()

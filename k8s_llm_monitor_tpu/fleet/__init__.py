@@ -9,6 +9,9 @@ per-replica circuit breakers, hedged dispatch, and mid-stream failover with
 the supervisor's idempotent-replay contract.  See docs/fleet.md.
 """
 
+from k8s_llm_monitor_tpu.fleet.autoscaler import (AutoscaleController,
+                                                  KubeScaleExecutor,
+                                                  LocalPoolExecutor)
 from k8s_llm_monitor_tpu.fleet.registry import (Candidate, ReplicaRegistry,
                                                 ReplicaStats)
 from k8s_llm_monitor_tpu.fleet.replica import (HTTPReplica, LocalReplica,
@@ -33,4 +36,7 @@ __all__ = [
     "LeastLoadedPolicy",
     "PrefixAffinityPolicy",
     "POLICIES",
+    "AutoscaleController",
+    "KubeScaleExecutor",
+    "LocalPoolExecutor",
 ]

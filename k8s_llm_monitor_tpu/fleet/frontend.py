@@ -109,7 +109,8 @@ def build_router_server(config, web_dir=None):
                           fixed_delay_s=fcfg.hedge_fixed_delay_s),
         max_failovers=fcfg.max_failovers,
         affinity_prefix_tokens=fcfg.affinity_prefix_tokens,
-        batch_spill_threshold=fcfg.batch_spill_threshold)
+        batch_spill_threshold=fcfg.batch_spill_threshold,
+        drain_sweep_budget=fcfg.drain_sweep_budget)
     registry.refresh()
     registry.start_probes(interval_s=fcfg.probe_interval_s)
     logger.info("router fronting %d replica(s), policy=%s, hedging=%s",
@@ -136,4 +137,31 @@ def build_router_server(config, web_dir=None):
         signals=signals)
     if signals is not None:
         signals.attach(srv)
+    if config.autoscale.enabled and signals is not None:
+        srv.autoscaler = _build_autoscaler(config, registry, signals)
     return srv
+
+
+def _build_autoscaler(config, registry, signals):
+    """Controller over the kube scale executor (StatefulSet /scale through
+    the hardened client).  Returns None — autoscaling disabled, router
+    unaffected — when no in-cluster credentials exist (dev/bench fleets
+    drive a ``LocalPoolExecutor`` directly instead)."""
+    from k8s_llm_monitor_tpu.fleet.autoscaler import (AutoscaleController,
+                                                      KubeScaleExecutor)
+    from k8s_llm_monitor_tpu.monitor.kube_rest import KubeRestBackend
+
+    try:
+        backend = KubeRestBackend.in_cluster()
+    except Exception as exc:  # noqa: BLE001 — no cluster: no autoscaler
+        logger.warning("autoscale.enabled but no cluster credentials "
+                       "(%s); elasticity controller disabled", exc)
+        return None
+    controller = AutoscaleController(
+        signals, KubeScaleExecutor(backend, config.autoscale),
+        config.autoscale, registry=registry)
+    logger.info("elasticity controller armed (interval=%.1fs, dwell=%.0fs, "
+                "cooldown=%.0fs)", config.autoscale.interval_s,
+                config.autoscale.scale_down_dwell_s,
+                config.autoscale.cooldown_s)
+    return controller

@@ -58,6 +58,13 @@ class ReplicaStats:
     shed_by_class: dict = dataclasses.field(default_factory=dict)
     ttft_ema_by_class: dict = dataclasses.field(default_factory=dict)
     preemptions_by_class: dict = dataclasses.field(default_factory=dict)
+    # Disaggregation role announced by the replica itself (FLEET_ROLE):
+    # "prefill" | "decode" | "unified".  Absent on pre-role replicas —
+    # treated as unified, so a mixed fleet keeps routing.
+    role: str = "unified"
+    # Lifecycle: a draining replica finishes its in-flight streams but
+    # must receive no new dispatches and must not win prefix affinity.
+    draining: bool = False
 
     @property
     def prefix_hit_rate(self) -> float:
@@ -90,6 +97,8 @@ class ReplicaStats:
             preemptions_by_class={
                 str(k): int(v) for k, v in
                 (eng.get("preemptions_by_class") or {}).items()},
+            role=str(eng.get("role") or "unified"),
+            draining=bool(eng.get("draining", False)),
         )
 
 
@@ -131,6 +140,12 @@ class ReplicaRegistry:
         # the telemetry plane compares probe ages against.
         self.probe_interval_s: float = 5.0
         self._entries: dict[str, _Entry] = {}
+        # Lifecycle subscribers: fired outside the lock.  on_drain fires
+        # once per rising edge of a replica's draining flag (the router's
+        # prefix-handout sweep); on_remove fires when a replica leaves the
+        # table (router/scraper state GC).
+        self._on_drain: list = []
+        self._on_remove: list = []
         # Created last (lockcheck: writes before the lock exists are
         # construction, not races).
         self._lock = make_lock("fleet.registry")
@@ -148,8 +163,28 @@ class ReplicaRegistry:
             self._entries[replica.replica_id] = entry
 
     def remove(self, replica_id: str) -> None:
+        """Drop a replica from the table.  Its breaker and inflight
+        counters die with the entry — nothing keeps probing (or alarming
+        on) a replica that left the fleet — and on_remove subscribers get
+        one shot at GC'ing their own per-replica state."""
         with self._lock:
-            self._entries.pop(replica_id, None)
+            removed = self._entries.pop(replica_id, None) is not None
+        if removed:
+            for cb in list(self._on_remove):
+                try:
+                    cb(replica_id)
+                except Exception:  # noqa: BLE001 — GC hooks must not raise
+                    logger.exception("on_remove hook failed for %s",
+                                     replica_id)
+
+    def subscribe_drain(self, callback) -> None:
+        """``callback(replica_id)`` on the rising edge of a replica's
+        draining announcement (probe-observed).  Called outside the lock."""
+        self._on_drain.append(callback)
+
+    def subscribe_remove(self, callback) -> None:
+        """``callback(replica_id)`` after a replica is removed."""
+        self._on_remove.append(callback)
 
     def ids(self) -> list[str]:
         with self._lock:
@@ -182,16 +217,19 @@ class ReplicaRegistry:
                 stats = replica.stats()
             except Exception as exc:  # noqa: BLE001 — probe must not raise
                 ready, reason = False, f"probe failed: {exc}"
+            drain_edge = False
             with self._lock:
                 entry = self._entries.get(rid)
                 if entry is None:
                     continue
                 was_ready = entry.ready
+                was_draining = entry.stats.draining
                 entry.ready = ready
                 entry.reason = reason
                 entry.last_probe_s = time.monotonic()
                 if stats is not None:
                     entry.stats = stats
+                    drain_edge = stats.draining and not was_draining
                 if ready:
                     entry.breaker.record_success()
                 else:
@@ -200,6 +238,13 @@ class ReplicaRegistry:
                 logger.info("replica %s -> %s%s", rid,
                             "ready" if ready else "unready",
                             f" ({reason})" if reason else "")
+            if drain_edge:
+                logger.info("replica %s announced draining", rid)
+                for cb in list(self._on_drain):
+                    try:
+                        cb(rid)
+                    except Exception:  # noqa: BLE001 — best-effort sweep
+                        logger.exception("on_drain hook failed for %s", rid)
 
     def start_probes(self, interval_s: float = 5.0) -> None:
         if self._probe_thread is not None:
@@ -232,7 +277,8 @@ class ReplicaRegistry:
         out = []
         with self._lock:
             for rid, e in self._entries.items():
-                if e.ready and e.breaker.state != "open":
+                if e.ready and not e.stats.draining \
+                        and e.breaker.state != "open":
                     out.append(Candidate(rid, e.replica, e.stats, e.inflight))
         return out
 
@@ -277,6 +323,8 @@ class ReplicaRegistry:
                 rid: {
                     "ready": e.ready,
                     "reason": e.reason,
+                    "role": e.stats.role,
+                    "draining": e.stats.draining,
                     "inflight": e.inflight,
                     "dispatches": e.dispatches,
                     "failures": e.failures,

@@ -111,13 +111,16 @@ class LocalReplica(Replica):
     supports_tokens = True
     supports_kv_migration = True
 
-    def __init__(self, replica_id: str, service=None, supervisor=None):
+    def __init__(self, replica_id: str, service=None, supervisor=None,
+                 role: str = "unified"):
         assert (service is None) != (supervisor is None), \
             "exactly one of service/supervisor"
         self.replica_id = replica_id
         self.supervisor = supervisor
         self._service = service
         self._killed = False
+        self.role = role
+        self._draining = False
 
     @property
     def service(self):
@@ -157,7 +160,20 @@ class LocalReplica(Replica):
             shed_by_class=dict(svc.shed_count_by_class),
             ttft_ema_by_class=dict(engine.ttft_ema_by_class),
             preemptions_by_class=dict(engine.preemptions_by_class),
+            role=self.role,
+            draining=self._draining,
         )
+
+    def drain(self) -> None:
+        """Announce draining: the next stats probe carries the flag, the
+        router stops dispatching here, and in-flight streams finish (or
+        fail over via the normal replay path).  ``close()`` remains the
+        actual teardown — drain is an announcement, not a stop."""
+        self._draining = True
+
+    @property
+    def draining(self) -> bool:
+        return self._draining
 
     def generate(self, prompt_ids: list[int], sampling=None,
                  request_id: str | None = None, deadline_s: float = 0.0,

@@ -31,6 +31,18 @@ The text-level path (``query``/``query_stream``/``analyze`` over
 ``HTTPReplica``) gets the same policy ranking and failover; a resumed SSE
 stream suppresses the already-delivered character prefix.  Hedging is
 token-level only (an SSE generator has no timed ``next``).
+
+Disaggregated roles (docs/fleet.md "Disaggregated roles & autoscaling"):
+when the fleet advertises both ``prefill``- and ``decode``-role replicas,
+a new request prefills (plus first token) on a prefill replica, then the
+finished prefix is streamed to a decode replica over the ``KVX1``
+export/install migration path and the remaining budget continues there.
+Every handoff failure mode — ``nospace``, ``incompatible``, owner death
+mid-transfer, install timeout, a torn blob — degrades to unified-style
+local decode on the prefill replica (whose KV already holds the prompt,
+so the continuation is a prefix hit, not a re-prefill); a dead prefill
+replica falls through to the normal failover replay.  A request is never
+dropped by the handoff ladder.
 """
 
 from __future__ import annotations
@@ -50,6 +62,7 @@ from k8s_llm_monitor_tpu.observability.tracing import Tracer, get_tracer
 from k8s_llm_monitor_tpu.resilience.errors import OverloadedError
 from k8s_llm_monitor_tpu.resilience.retry import CircuitOpen
 from k8s_llm_monitor_tpu.serving.engine import GenerationResult, SamplingParams
+from k8s_llm_monitor_tpu.serving.kv_tier import BlobError
 from k8s_llm_monitor_tpu.serving.service import RequestHandle
 
 logger = logging.getLogger("fleet.router")
@@ -205,14 +218,20 @@ class _Flight:
     # flight resolves, so children never point at an unrecorded parent.
     trace: object = None
     submit_t0: float = 0.0
+    # Disaggregation: this flight was dispatched to a prefill-role replica
+    # with a 1-token budget; on clean completion the pump runs the handoff
+    # ladder instead of finishing the stream.
+    pending_decode: bool = False
 
 
 _DONE = object()
+_HANDOFF = object()
 
 
 @guarded_by("_lock", "dispatches", "completed", "failed", "sheds",
             "failovers", "hedges_fired", "hedges_won", "affinity_hits",
-            "affinity_spills", "_migrations", "_ttft_m", "_ttft_dev")
+            "affinity_spills", "_migrations", "_ttft_m", "_ttft_dev",
+            "_handoffs", "_recent_prefixes", "drain_sweeps")
 class FleetRouter:
     """Routes requests over a ``ReplicaRegistry`` with the selected policy,
     per-replica circuit breaking, optional hedging, and mid-stream
@@ -225,7 +244,8 @@ class FleetRouter:
                  affinity_prefix_tokens: int = 64,
                  stall_timeout_s: float = 120.0,
                  batch_spill_threshold: float = 0.75,
-                 migrate_prefixes: bool = True):
+                 migrate_prefixes: bool = True,
+                 drain_sweep_budget: int = 8):
         if policy not in POLICIES:
             raise ValueError(
                 f"unknown policy {policy!r} (have {sorted(POLICIES)})")
@@ -256,12 +276,31 @@ class FleetRouter:
         # counters feed prefix_migrations_total{outcome}.
         self.migrate_prefixes = migrate_prefixes
         self._migrations: dict[str, int] = {}
+        # Prefill->decode handoff outcomes (fleet_handoffs_total{outcome}):
+        # "decode" = continuation landed on a decode replica with the
+        # installed prefix; "local" = degraded to local decode on the
+        # prefill replica; failure-cause keys (nospace / incompatible /
+        # owner_down / miss / torn / install_timeout / error / no_decode /
+        # dispatch_failed) count WHY a handoff degraded.
+        self._handoffs: dict[str, int] = {}
+        # Recently-dispatched prefix heads: digest -> (head tokens, last
+        # replica).  The drain sweep reads this to proactively offer a
+        # draining replica's cached prefixes to their new rendezvous
+        # owners; bounded LRU so it never grows with traffic.
+        self._recent_prefixes: dict[bytes, tuple[list[int], str]] = {}
+        self._recent_prefixes_cap = 128
+        self.drain_sweep_budget = drain_sweep_budget
+        self.drain_sweeps = 0
         # online TTFT stats for the hedge delay
         self._ttft_m: float | None = None
         self._ttft_dev: float = 0.0
         self._ttft_alpha = 0.2
         # Created last (lockcheck construction rule).
         self._lock = make_lock("fleet.router")
+        # Membership lifecycle hooks: offer a draining replica's prefixes
+        # to their replacements; GC affinity memory for removed replicas.
+        registry.subscribe_drain(self._drain_sweep)
+        registry.subscribe_remove(self.forget_replica)
 
     # -- shared plumbing -------------------------------------------------
 
@@ -282,6 +321,8 @@ class FleetRouter:
                 "affinity_hits": self.affinity_hits,
                 "affinity_spills": self.affinity_spills,
                 "prefix_migrations": dict(self._migrations),
+                "handoffs": dict(self._handoffs),
+                "drain_sweeps": self.drain_sweeps,
             }
 
     def telemetry_sample(self) -> dict:
@@ -372,6 +413,10 @@ class FleetRouter:
         with self._lock:
             self._migrations[outcome] = self._migrations.get(outcome, 0) + 1
 
+    def _bump_handoff(self, outcome: str) -> None:
+        with self._lock:
+            self._handoffs[outcome] = self._handoffs.get(outcome, 0) + 1
+
     def _maybe_migrate_prefix(self, digest: bytes, prompt_ids: list[int],
                               ranked: list[Candidate]) -> None:
         """When dispatch is about to land off the affinity owner, pull the
@@ -428,6 +473,90 @@ class FleetRouter:
         if outcome == "installed":
             logger.info("migrated prefix %s... %s -> %s",
                         digest[:4].hex(), pref, target.replica_id)
+
+    # -- membership lifecycle: drain sweep + removal GC ------------------
+
+    def _note_prefix(self, digest: bytes, prompt_ids: list[int],
+                     replica_id: str) -> None:
+        head = list(prompt_ids[: self.affinity_prefix_tokens])
+        with self._lock:
+            self._recent_prefixes.pop(digest, None)
+            self._recent_prefixes[digest] = (head, replica_id)
+            while len(self._recent_prefixes) > self._recent_prefixes_cap:
+                self._recent_prefixes.pop(
+                    next(iter(self._recent_prefixes)))
+
+    def forget_replica(self, replica_id: str) -> None:
+        """Removal GC: drop the affinity-memory entries that point at a
+        replica that left the fleet (wired to ``registry.subscribe_remove``
+        — the registry already dropped its breaker/inflight state)."""
+        with self._lock:
+            for dig in [d for d, (_, owner)
+                        in self._recent_prefixes.items()
+                        if owner == replica_id]:
+                del self._recent_prefixes[dig]
+
+    def _drain_sweep(self, replica_id: str) -> None:
+        """Best-effort prefix handout on a replica's draining edge: offer
+        up to ``drain_sweep_budget`` of its recently-served prefixes to
+        their new rendezvous owners (the draining replica no longer wins
+        affinity — ``candidates()`` excludes it — so without the sweep
+        every one of its hot prefixes re-prefills cold elsewhere).  Every
+        failure mode is swallowed: draining must never block on this."""
+        entry = self.registry.get(replica_id)
+        if (entry is None
+                or not getattr(entry.replica, "supports_kv_migration",
+                               False)):
+            return
+        with self._lock:
+            owned = [(dig, head) for dig, (head, owner)
+                     in self._recent_prefixes.items()
+                     if owner == replica_id]
+        cands = [c for c in self.registry.candidates()
+                 if c.replica.supports_kv_migration
+                 and c.replica_id != replica_id]
+        if not cands or not owned:
+            return
+        moved = 0
+        for dig, head in owned:
+            if moved >= self.drain_sweep_budget:
+                break
+            pref = self.policy.preferred(cands, dig)
+            target = next((c for c in cands if c.replica_id == pref), None)
+            if target is None:
+                ranked = self.policy.rank(cands, dig)
+                target = ranked[0] if ranked else None
+            if target is None:
+                break
+            try:
+                blob = entry.replica.fetch_prefix(head)
+            except ReplicaUnavailable:
+                self._bump_migration("owner_down")
+                break  # owner died mid-drain: nothing more to offer
+            except Exception:  # noqa: BLE001 — sweep is best-effort
+                logger.exception("drain sweep fetch from %s failed",
+                                 replica_id)
+                self._bump_migration("error")
+                break
+            if blob is None:
+                self._bump_migration("miss")
+                continue
+            try:
+                outcome = str(target.replica.install_prefix(blob))
+            except Exception:  # noqa: BLE001 — sweep is best-effort
+                logger.exception("drain sweep install on %s failed",
+                                 target.replica_id)
+                self._bump_migration("error")
+                continue
+            self._bump_migration(outcome)
+            if outcome in ("installed", "cached"):
+                moved += 1
+                with self._lock:
+                    self._recent_prefixes[dig] = (head, target.replica_id)
+        if moved:
+            self._bump("drain_sweeps", moved)
+            logger.info("drain sweep moved %d prefixes off %s",
+                        moved, replica_id)
 
     # -- token-level dispatch -------------------------------------------
 
@@ -489,9 +618,25 @@ class FleetRouter:
         digest = self._token_digest(prompt_ids)
         t_rank = time.monotonic()
         ranked = self._ranked(digest, need_tokens=True, slo_class=slo_class)
+        # Disaggregated dispatch: with both roles present, the request
+        # prefills (plus first token) on a prefill replica and the pump
+        # hands the finished prefix to a decode replica.  A fleet missing
+        # either role — or a 1-token request, where there is nothing to
+        # hand off — dispatches unified.
+        prefill_ranked = [c for c in ranked if c.stats.role == "prefill"]
+        disagg = (bool(prefill_ranked)
+                  and any(c.stats.role == "decode" for c in ranked)
+                  and sampling.max_tokens > 1)
         chosen, handle = (None, None)
         with tracer.use(trace):
-            if ranked:
+            if disagg:
+                chosen, handle = self._dispatch_tokens(
+                    prefill_ranked, prompt_ids,
+                    dataclasses.replace(sampling, max_tokens=1),
+                    f"{rid}-a0", deadline_s, slo_class=slo_class)
+                if chosen is None:
+                    disagg = False  # no prefill taker: degrade to unified
+            if not disagg and ranked and chosen is None:
                 self._maybe_migrate_prefix(digest, prompt_ids, ranked)
                 chosen, handle = self._dispatch_tokens(
                     ranked, prompt_ids, sampling, f"{rid}-a0", deadline_s,
@@ -508,16 +653,18 @@ class FleetRouter:
                 retriable=True, retry_after_s=1.0, slo_class=slo_class,
                 request_id=rid)
         self._account_affinity(digest, chosen, ranked)
+        self._note_prefix(digest, prompt_ids, chosen)
         tracer.record("router.dispatch", t_rank, time.monotonic(), trace,
                       attrs={"request_id": rid, "replica": chosen,
-                             "attempt": 0, "class": slo_class})
+                             "attempt": 0, "class": slo_class,
+                             "disaggregated": disagg})
 
         flight = _Flight(
             rid=rid, prompt_ids=list(prompt_ids), sampling=sampling,
             deadline_s=deadline_s, digest=digest, slo_class=slo_class,
             handle=RequestHandle(rid, eos_id=None), inner=handle,
             replica_id=chosen, dispatch_t0=time.monotonic(), trace=trace,
-            submit_t0=t_rank)
+            submit_t0=t_rank, pending_decode=disagg)
         flight.handle._cancel_fn = lambda _rid: self._cancel_flight(flight)
         threading.Thread(target=self._pump, args=(flight,),
                          name=f"fleet-pump-{rid}", daemon=True).start()
@@ -560,6 +707,15 @@ class FleetRouter:
                     outcome = self._consume(fl)
                     if outcome is _DONE:
                         return
+                    if outcome is _HANDOFF:
+                        # Prefill leg finished cleanly: hand the prefix to
+                        # a decode replica (or degrade to local decode) —
+                        # the prefill replica's inflight/breaker credit was
+                        # already settled in _consume.
+                        err = self._handoff(fl)
+                        if err is None:
+                            continue
+                        return self._fail(fl, err)
                     # Replica died mid-generation: fold emitted tokens into
                     # the prompt, trim the budget, resubmit elsewhere
                     # (supervisor replay contract, fleet-wide).
@@ -567,6 +723,7 @@ class FleetRouter:
                     self.registry.mark_unready(fl.replica_id, str(outcome))
                     self._bump("failovers")
                     fl.attempts += 1
+                    fl.pending_decode = False  # replay carries full budget
                     if fl.cancelled:
                         return self._fail(fl, "cancelled")
                     if fl.attempts > self.max_failovers:
@@ -612,8 +769,11 @@ class FleetRouter:
         # Hedging doubles device work for one request: never for batch
         # traffic, and not while the primary reports brownout (degraded or
         # worse) — the extra dispatch is exactly what it is shedding.
+        # (A pending-decode prefill leg never hedges either: its 1-token
+        # budget is the short leg, and a hedge would race the full budget.)
         if (self.hedge.enabled and first and fl.attempts == 0
                 and not fl.cancelled and fl.slo_class != "batch"
+                and not fl.pending_decode
                 and not self._replica_browned_out(fl.replica_id)):
             hedged = self._maybe_hedge(fl)
             if hedged is not None:
@@ -634,6 +794,15 @@ class FleetRouter:
                 res = inner.result(timeout=10.0)
                 if res.finish_reason == "error" and not fl.cancelled:
                     return res.error or "replica failed"
+                if (fl.pending_decode and not fl.cancelled
+                        and res.finish_reason == "length"
+                        and fl.sampling.max_tokens > len(fl.emitted)):
+                    # The 1-token prefill budget is spent but the caller's
+                    # budget isn't: this is the handoff point, not the end
+                    # of the stream.  (EOS inside the prefill leg — a
+                    # "stop" finish — completes normally below.)
+                    self.registry.note_done(fl.replica_id, ok=True)
+                    return _HANDOFF
                 fl.handle._replay_prefix = list(fl.prior)
                 fl.handle._push([], res)
                 self.registry.note_done(
@@ -647,6 +816,124 @@ class FleetRouter:
                 self._note_ttft(time.monotonic() - fl.dispatch_t0)
             fl.emitted.append(tok)
             fl.handle._push([tok], None)
+
+    def _handoff(self, fl: _Flight) -> Optional[str]:
+        """The prefill→decode handoff ladder.  The prefill replica P has
+        finished the prompt (plus first token); its KV pool holds the full
+        prefix.  Rungs, in order:
+
+        1. Export the prefix from P and install it on the best decode
+           candidate D; on ``installed``/``cached``, dispatch the
+           remaining budget to D (suffix-only admission — the DistServe
+           move).
+        2. Any handoff failure (``nospace``, ``incompatible``, owner
+           death, install timeout, torn blob, no decode candidate, D
+           refusing the dispatch) degrades to **local decode on P** —
+           P's prefix cache still holds the prompt, so this is a hit,
+           not a re-prefill.
+        3. P itself dead: the normal failover ranking over everyone else
+           (a plain replay — the only rung that re-prefills).
+
+        Returns None with ``fl.inner`` streaming the continuation, or an
+        error message only when no replica anywhere would take it."""
+        fl.pending_decode = False
+        prefill_id = fl.replica_id
+        remaining = fl.sampling.max_tokens - len(fl.emitted)
+        cont = dataclasses.replace(fl.sampling, max_tokens=remaining)
+        prompt = fl.prompt_ids + fl.emitted
+        t0 = time.monotonic()
+        ranked = self._ranked(fl.digest, need_tokens=True,
+                              slo_class=fl.slo_class)
+        entry = self.registry.get(prefill_id)
+        owner = entry.replica if entry is not None else None
+        decode_ranked = [c for c in ranked
+                         if c.stats.role == "decode"
+                         and c.replica_id != prefill_id
+                         and c.replica.supports_kv_migration]
+
+        cause: Optional[str] = None
+        chosen, handle = None, None
+        if not decode_ranked:
+            cause = "no_decode"
+        elif owner is None or not getattr(owner, "supports_kv_migration",
+                                          False):
+            cause = "owner_down"
+        else:
+            target = decode_ranked[0]
+            blob = None
+            try:
+                blob = owner.fetch_prefix(prompt)
+            except ReplicaUnavailable:
+                cause = "owner_down"
+            except Exception:  # noqa: BLE001 — handoff is best-effort
+                logger.exception("handoff fetch from %s failed", prefill_id)
+                cause = "error"
+            if cause is None and blob is None:
+                cause = "miss"
+            if cause is None:
+                try:
+                    outcome = str(target.replica.install_prefix(blob))
+                except BlobError:
+                    cause = "torn"
+                except ReplicaUnavailable:
+                    # Covers both install timeouts and a target that died
+                    # mid-transfer — either way the blob never landed.
+                    cause = "install_timeout"
+                except Exception:  # noqa: BLE001 — handoff is best-effort
+                    logger.exception("handoff install on %s failed",
+                                     target.replica_id)
+                    cause = "error"
+                else:
+                    if outcome not in ("installed", "cached"):
+                        cause = outcome  # nospace | incompatible
+            if cause is None:
+                chosen, handle = self._dispatch_tokens(
+                    [target], prompt, cont, f"{fl.rid}-d{fl.attempts}",
+                    fl.deadline_s, slo_class=fl.slo_class)
+                if chosen is None:
+                    cause = "dispatch_failed"
+
+        landing = "decode"
+        if chosen is None:
+            # Degrade: local decode on P (rung 2).  P may be draining or
+            # mid-removal from the candidate set — dispatch to it directly
+            # (draining replicas finish their own work, they just take no
+            # NEW requests; a handoff fallback is this request's work).
+            self._bump_handoff(cause or "error")
+            local = next((c for c in ranked
+                          if c.replica_id == prefill_id), None)
+            if local is None and entry is not None:
+                local = Candidate(prefill_id, entry.replica, entry.stats,
+                                  entry.inflight)
+            if local is not None:
+                chosen, handle = self._dispatch_tokens(
+                    [local], prompt, cont, f"{fl.rid}-l{fl.attempts}",
+                    fl.deadline_s, slo_class=fl.slo_class)
+            landing = "local"
+        if chosen is None:
+            # Rung 3: P is gone too — plain failover replay elsewhere.
+            chosen, handle = self._dispatch_tokens(
+                ranked, prompt, cont, f"{fl.rid}-f{fl.attempts}",
+                fl.deadline_s, exclude={prefill_id},
+                slo_class=fl.slo_class)
+            landing = "replay"
+        if chosen is None:
+            return (f"handoff failed ({cause or 'no target'}) and no "
+                    "replica would take the continuation")
+        self._bump_handoff(landing)
+        get_tracer().record(
+            "router.handoff", t0, time.monotonic(), fl.trace,
+            status="ok" if landing == "decode" else "error",
+            attrs={"request_id": fl.rid, "from": prefill_id,
+                   "to": chosen, "landing": landing,
+                   "cause": cause or "", "tokens": len(fl.emitted)})
+        if landing != "decode":
+            logger.info("handoff for %s degraded to %s on %s (%s)",
+                        fl.rid, landing, chosen, cause)
+        fl.prior = list(fl.emitted)
+        fl.replica_id, fl.inner = chosen, handle
+        fl.dispatch_t0 = time.monotonic()
+        return None
 
     def _replica_browned_out(self, replica_id: str) -> bool:
         entry = self.registry.get(replica_id)

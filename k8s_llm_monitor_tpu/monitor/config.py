@@ -230,6 +230,17 @@ class FleetConfig:
     # a non-affinity replica whose load score is below this fraction of
     # capacity; interactive requests always route least-loaded.
     batch_spill_threshold: float = 0.75
+    # Disaggregation role of THIS process (FLEET_ROLE env on replicas):
+    # "prefill" replicas take new prompts and hand the finished prefix to
+    # a "decode" replica over the KVX1 migration path; "unified" does
+    # both.  The router reads each replica's role from its stats
+    # heartbeat — misconfigured or mixed fleets degrade to unified
+    # dispatch, never to dropped requests (docs/fleet.md).
+    role: str = "unified"  # prefill | decode | unified
+    # Best-effort prefix handout when a replica announces draining: at
+    # most this many cached prefixes are offered to their new rendezvous
+    # owners via export_prefix/install_prefix before the replica leaves.
+    drain_sweep_budget: int = 8
 
 
 @dataclass
@@ -270,6 +281,47 @@ class TelemetryConfig:
 
 
 @dataclass
+class AutoscaleConfig:
+    """Elasticity controller (fleet/autoscaler.py): closes the telemetry
+    plane's sense loop by acting on per-target ``scale_hint``s through
+    per-role StatefulSet scale subresources (or an in-process LocalReplica
+    pool under test).  New; no reference equivalent."""
+
+    enabled: bool = False
+    # Decision cadence (the controller also exposes a tick() seam so
+    # tests drive it with a fake clock).
+    interval_s: float = 10.0
+    # Per-role replica bounds.  Unknown/unified targets count against the
+    # "unified" role.
+    min_prefill: int = 1
+    max_prefill: int = 4
+    min_decode: int = 1
+    max_decode: int = 4
+    min_unified: int = 1
+    max_unified: int = 4
+    # Hysteresis: scale-down requires the role's hints to agree "down"
+    # continuously for the dwell; any executed action opens a cooldown
+    # during which the controller refuses to act again.
+    scale_down_dwell_s: float = 60.0
+    cooldown_s: float = 30.0
+    # Flap damping: more than this many per-role direction changes inside
+    # the window refuses further actions until hints settle.
+    flap_window_s: float = 120.0
+    flap_max_flips: int = 3
+    # Kube execution: per-role StatefulSet names under `namespace`;
+    # every scale is issued dry-run first, then for real, through the
+    # hardened client's retry/breaker path.
+    namespace: str = "monitoring"
+    statefulset_prefill: str = "engine-prefill"
+    statefulset_decode: str = "engine-decode"
+    statefulset_unified: str = "engine"
+    dry_run_first: bool = True
+    # Per-verb circuit breaker on the scale subresource.
+    breaker_failures: int = 3
+    breaker_cooldown_s: float = 30.0
+
+
+@dataclass
 class LoggingConfig:
     level: str = "info"
     format: str = "json"  # ref config.go default
@@ -289,6 +341,7 @@ class Config:
     lifecycle: LifecycleConfig = field(default_factory=LifecycleConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
+    autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
 
 

@@ -499,7 +499,7 @@ def _fleet_metrics(w: _Writer, router) -> None:
     the router's hedging/failover/affinity counters (PR 5)."""
     snap = router.registry.snapshot()
     ready, inflight, hit_rate, dispatches, failures = [], [], [], [], []
-    ages = []
+    ages, roles, draining = [], [], []
     for rid, rep in sorted(snap.items()):
         label = f'{{replica="{rid}"}}'
         ready.append((label, 1 if rep["ready"] else 0))
@@ -509,6 +509,9 @@ def _fleet_metrics(w: _Writer, router) -> None:
         failures.append((label, rep["failures"]))
         age = rep.get("probe_age_s")
         ages.append((label, age if age is not None else float("nan")))
+        role = rep.get("role", "unified")
+        roles.append((f'{{replica="{rid}",role="{role}"}}', 1))
+        draining.append((label, 1 if rep.get("draining") else 0))
     if ready:
         w.metric("fleet_replica_ready", "gauge",
                  "Replica readiness as the router sees it", ready)
@@ -529,6 +532,14 @@ def _fleet_metrics(w: _Writer, router) -> None:
         w.metric("fleet_scrape_age_s", "gauge",
                  "Seconds since each replica's last completed stats probe "
                  "(NaN = never probed)", ages)
+        # Disaggregation (PR 14): the role is a label, the value is a
+        # constant 1 — join on {replica} to slice any fleet metric by role.
+        w.metric("fleet_replica_role", "gauge",
+                 "Replica serving role (prefill/decode/unified) as an "
+                 "info-style gauge", roles)
+        w.metric("fleet_replica_draining", "gauge",
+                 "1 while the replica announces draining (router stops "
+                 "dispatching; in-flight streams finish)", draining)
     c = router.counters()
     w.metric("fleet_affinity_hits_total", "counter",
              "Dispatches that landed on the policy's preferred replica",
@@ -562,6 +573,45 @@ def _fleet_metrics(w: _Writer, router) -> None:
              "Prefix migrations attempted on affinity misses, by outcome "
              "(installed = pages moved instead of re-prefilling)",
              [(f'{{outcome="{o}"}}', mig.get(o, 0)) for o in outcomes])
+    # Disaggregated prefill→decode handoffs (PR 14).  Landing outcomes
+    # (decode/local/replay) and failure causes share one family: the
+    # causes explain why a handoff degraded to local decode.  All known
+    # outcomes pre-seed at 0 so rate() works before the first handoff.
+    hand = dict(c.get("handoffs") or {})
+    h_outcomes = ["decode", "local", "replay", "no_decode", "owner_down",
+                  "miss", "torn", "install_timeout", "nospace",
+                  "incompatible", "dispatch_failed", "error"]
+    h_outcomes += sorted(o for o in hand if o not in h_outcomes)
+    w.metric("fleet_handoffs_total", "counter",
+             "Prefill->decode handoff attempts by landing (decode = "
+             "disaggregated, local = degraded to prefill replica, replay "
+             "= owner died) and by failure cause",
+             [(f'{{outcome="{o}"}}', hand.get(o, 0)) for o in h_outcomes])
+    w.metric("fleet_drain_sweeps_total", "counter",
+             "Prefixes exported off draining replicas to their new "
+             "rendezvous owners", [("", c.get("drain_sweeps", 0))])
+
+
+def _autoscaler_metrics(w: _Writer, ctl) -> None:
+    """Elasticity controller accounting: every decision — applied,
+    errored, or refused by a hysteresis gate — is a counted outcome."""
+    totals = dict(ctl.counters()["actions_total"])
+    # Pre-seed the cells dashboards alert on, keep any others.
+    seeds = [(role, direction, outcome)
+             for role in ("prefill", "decode", "unified")
+             for direction in ("up", "down")
+             for outcome in ("applied", "refused_cooldown", "refused_dwell")]
+    for key in seeds:
+        totals.setdefault(key, 0)
+    w.metric("autoscale_actions_total", "counter",
+             "Autoscale decisions by role, direction (up/down/rebalance) "
+             "and outcome (applied, error, or the refusing gate)",
+             [(f'{{role="{r}",direction="{d}",outcome="{o}"}}', n)
+              for (r, d, o), n in sorted(totals.items())])
+    w.metric("autoscale_breaker_open", "gauge",
+             "1 while the controller's executor breaker is open "
+             "(decisions refused, not retried)",
+             [("", 1 if ctl.breaker.state == "open" else 0)])
 
 
 def _diagnosis_metrics(w: _Writer, pipeline, backend) -> None:
@@ -647,6 +697,10 @@ def _telemetry_metrics(w: _Writer, scraper) -> None:
              "Anomaly flags raised by the derived-signal layer "
              "(edge-triggered, per target+flag cooldown)",
              [("", c["anomalies_total"])])
+    w.metric("telemetry_evicted_targets_total", "counter",
+             "Departed fleet targets whose series were GC'd from the "
+             "store (membership-lifecycle probe-leak cleanup)",
+             [("", c.get("evicted_targets_total", 0))])
     t = scraper.store.totals()
     w.metric("telemetry_series", "gauge",
              "Live time series held by the in-process store",
@@ -704,6 +758,9 @@ def render_prometheus(srv: "MonitorServer", openmetrics: bool = False) -> str:
     router = getattr(srv.analysis, "router", None)
     if router is not None:
         _fleet_metrics(w, router)
+    autoscaler = getattr(srv, "autoscaler", None)
+    if autoscaler is not None:
+        _autoscaler_metrics(w, autoscaler)
     if srv.manager is not None:
         _manager_metrics(w, srv.manager)
     backend = getattr(srv.analysis, "backend", None)

@@ -331,6 +331,7 @@ class KubeRestBackend(ClusterBackend):
         body: dict | None = None,
         raw: bool = False,
         stream: bool = False,
+        content_type: str | None = None,
     ) -> Any:
         """One apiserver call with retry + circuit breaking.
 
@@ -353,7 +354,7 @@ class KubeRestBackend(ClusterBackend):
             try:
                 result = self._request_once(
                     path, params, method=method, body=body,
-                    raw=raw, stream=stream)
+                    raw=raw, stream=stream, content_type=content_type)
             except (NotFound, Conflict):
                 self.breaker.record_success()
                 raise
@@ -377,6 +378,7 @@ class KubeRestBackend(ClusterBackend):
         body: dict | None = None,
         raw: bool = False,
         stream: bool = False,
+        content_type: str | None = None,
     ) -> Any:
         faults = get_injector()
         if faults.should_fire("kube_http_timeout"):
@@ -395,7 +397,7 @@ class KubeRestBackend(ClusterBackend):
         headers = self._headers()
         if body is not None:
             data = json.dumps(body).encode()
-            headers["Content-Type"] = "application/json"
+            headers["Content-Type"] = content_type or "application/json"
         req = urllib.request.Request(url, data=data, headers=headers,
                                      method=method)
         timeout = self.watch_timeout if stream else self.timeout
@@ -496,6 +498,29 @@ class KubeRestBackend(ClusterBackend):
     def list_network_policies(self, namespace: str) -> list[dict[str, Any]]:
         return self._items(
             f"/apis/networking.k8s.io/v1/namespaces/{namespace}/networkpolicies")
+
+    # -- workload scaling (autoscaler executor) -------------------------
+
+    def get_statefulset_scale(self, namespace: str, name: str) -> dict[str, Any]:
+        """The ``/scale`` subresource of one StatefulSet (spec.replicas is
+        desired, status.replicas is observed)."""
+        return self._request(
+            f"/apis/apps/v1/namespaces/{namespace}/statefulsets/{name}/scale")
+
+    def scale_statefulset(self, namespace: str, name: str, replicas: int,
+                          dry_run: bool = False) -> dict[str, Any]:
+        """PATCH the ``/scale`` subresource to ``replicas``.  Merge-patch
+        on the scale object is idempotent, so it rides the normal retry
+        budget (PATCH != POST).  ``dry_run=True`` sends ``dryRun=All`` —
+        full apiserver validation + admission, no persistence — which is
+        how the autoscaler proves a scale verb works before using it."""
+        params = {"dryRun": "All"} if dry_run else None
+        return self._request(
+            f"/apis/apps/v1/namespaces/{namespace}/statefulsets/{name}/scale",
+            params,
+            method="PATCH",
+            body={"spec": {"replicas": int(replicas)}},
+            content_type="application/merge-patch+json")
 
     def pod_logs(self, namespace: str, name: str, tail_lines: int = 100) -> str:
         return self._request(

@@ -93,6 +93,14 @@ class MonitorServer:
         self.web_dir = Path(web_dir) if web_dir else DEFAULT_WEB_DIR
         self.host = host if host is not None else self.config.server.host
         self.port = port if port is not None else self.config.server.port
+        # Membership lifecycle: flipped by graceful shutdown (or an
+        # operator) so /api/v1/stats announces draining one probe before
+        # the process leaves — the router stops dispatching here while
+        # in-flight streams finish.
+        self.draining = False
+        # fleet.autoscaler.AutoscaleController on router-role processes
+        # with autoscale.enabled; wired by frontend.build_router_server.
+        self.autoscaler = None
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -223,6 +231,10 @@ class MonitorServer:
                     k: round(v, 6)
                     for k, v in engine.ttft_ema_by_class.items()},
                 "preemptions_by_class": dict(engine.preemptions_by_class),
+                # Disaggregation: the fleet probe reads this replica's
+                # role + drain announcement from the same snapshot.
+                "role": self.config.fleet.role,
+                "draining": bool(self.draining),
             }
         router = self.fleet_router()
         if router is not None:
@@ -231,6 +243,8 @@ class MonitorServer:
                 "counters": router.counters(),
                 "hedge_delay_s": round(router.hedge_delay_s(), 4),
             }
+            if self.autoscaler is not None:
+                snap["fleet"]["autoscaler"] = self.autoscaler.snapshot()
         return snap
 
     # -- lifecycle -------------------------------------------------------------

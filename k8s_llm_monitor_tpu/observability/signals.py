@@ -60,7 +60,8 @@ def _num(value: float, digits: int = 4) -> Optional[float]:
 
 
 @guarded_by("_lock", "scrapes_total", "scrape_errors_total",
-            "anomalies_total", "_probe_interval_s")
+            "anomalies_total", "_probe_interval_s", "_fleet_targets",
+            "evicted_targets_total")
 class SignalScraper:
     """Samples load signals into a ``TimeSeriesStore`` and derives the
     autoscaler/anomaly contract from the recorded windows.
@@ -95,6 +96,11 @@ class SignalScraper:
         self._recent_anomalies: deque[dict] = deque(maxlen=32)
         self._last_emit: dict[str, float] = {}
         self._probe_interval_s: float = 0.0
+        # Fleet targets seen on the previous scrape — membership GC:
+        # a replica that left the registry gets its series evicted
+        # instead of lingering as a permanently-stale alarm target.
+        self._fleet_targets: set[str] = set()
+        self.evicted_targets_total = 0
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         # Created last (lockcheck).
@@ -209,8 +215,13 @@ class SignalScraper:
         Stale rows (probe age beyond ``stale_after_probes`` intervals, or
         never probed) record NaN markers, never frozen values."""
         interval = max(float(probe_interval_s), 1e-3)
+        current = set(rows)
         with self._lock:
             self._probe_interval_s = interval
+            departed = self._fleet_targets - current
+            self._fleet_targets = current
+        for rid in sorted(departed):
+            self.evict_target(rid)
         stale_after = self.cfg.stale_after_probes * interval
         rec = self.store.record
         for rid, row in sorted(rows.items()):
@@ -256,6 +267,28 @@ class SignalScraper:
                 rec("kv_spills_total", kv.get("spills", 0), lab, t)
                 rec("kv_restores_total", kv.get("restores", 0), lab, t)
             rec("busy_slots", row.get("busy_slots", 0), lab, t)
+
+    def evict_target(self, target: str) -> int:
+        """Membership GC for one departed fleet target: drop every
+        ``{replica=target}`` series (so ``scrape_age_s`` and friends stop
+        reading as stale alarms, and the cardinality cap isn't spent on
+        dead replicas) and forget its anomaly cooldown keys.  Returns the
+        number of series evicted.  Called automatically when a fleet
+        scrape no longer lists the target; also safe to call directly
+        from a registry on_remove hook."""
+        if target == LOCAL_TARGET:
+            return 0
+        n = self.store.evict({"replica": target})
+        prefix = f"{target}:"
+        with self._lock:
+            for key in [k for k in self._last_emit if k.startswith(prefix)]:
+                del self._last_emit[key]
+            if n:
+                self.evicted_targets_total += 1
+        if n:
+            logger.info("evicted %d series for departed replica %s",
+                        n, target)
+        return n
 
     # -- derived signals -------------------------------------------------
 
@@ -413,6 +446,7 @@ class SignalScraper:
                 "scrape_errors_total": self.scrape_errors_total,
                 "anomalies_total": self.anomalies_total,
                 "anomalies_by_flag": dict(self.anomalies_by_flag),
+                "evicted_targets_total": self.evicted_targets_total,
             }
 
     # -- anomaly → diagnosis feed ---------------------------------------

@@ -89,6 +89,22 @@ class TimeSeriesStore:
             ring.append((stamp, v))
             self.points_total += 1
 
+    def evict(self, labels: dict) -> int:
+        """Drop every series whose labels contain ``labels`` as a subset;
+        returns the number of series removed.  Fleet-membership GC: when a
+        replica leaves, its ``{replica=...}`` series must not linger as
+        permanently-stale signal targets (or crowd new replicas out of the
+        ``max_series`` cap)."""
+        want = _label_items(labels)
+        if not want:
+            return 0
+        with self._lock:
+            doomed = [key for key in self._series
+                      if set(want) <= set(key[1])]
+            for key in doomed:
+                del self._series[key]
+        return len(doomed)
+
     # -- reading ---------------------------------------------------------
 
     def series_count(self) -> int:
