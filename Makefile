@@ -9,7 +9,8 @@ SHELL := /bin/bash    # tier1 uses pipefail/PIPESTATUS
         chaos chaos-lifecycle chaos-fleet chaos-overload chaos-kvtier \
         chaos-trace chaos-signals chaos-elastic \
         diagnose-e2e bench bench-decode \
-        bench-fleet bench-mesh bench-signals bench-elastic dryrun smoke \
+        bench-fleet bench-mesh bench-signals bench-elastic bench-prefill \
+        dryrun smoke \
         preflight \
         deploy-agent docker \
         docker-agent docker-scheduler lint lint-trace clean
@@ -53,7 +54,8 @@ tier1-mesh:
 	$(TEST_ENV) XLA_FLAGS=--xla_force_host_platform_device_count=8 \
 	  K8SLLM_LOCKCHECK=1 \
 	  $(PY) -m pytest tests/test_sharding.py tests/test_spec_decode.py \
-	  tests/test_overlap.py -q -p no:cacheprovider
+	  tests/test_overlap.py tests/test_flash_prefill.py -q \
+	  -p no:cacheprovider
 
 chaos:              # fault-injection resilience suite (docs/resilience.md)
 	$(TEST_ENV) $(PY) -m pytest tests/ -q -m chaos -p no:cacheprovider
@@ -142,6 +144,14 @@ bench-mesh:
 	  BENCH_MESH_CONCURRENCY=12 BENCH_MESH_PROMPT_LEN=48 \
 	  BENCH_MESH_MAX_TOKENS=12 BENCH_MESH_SLOTS=8 \
 	  $(PY) bench.py | tee mesh-bench.json
+
+# Long-prefill smoke: flash-vs-dense TTFT ladder, the chunked-vs-single-
+# bucket crossover, the int8-pool variant, and the dense-skip branch
+# (analytic transient bytes over budget) on a tiny CPU engine.  The
+# measured 2k/8k/32k leg runs on real TPU hardware with the defaults.
+bench-prefill:
+	$(TEST_ENV) BENCH_PREFILL_ONLY=1 BENCH_MODEL=tiny BENCH_QUANT=none \
+	  $(PY) bench.py | tee prefill-bench.json
 
 # Telemetry-plane overhead smoke: scraper-on vs scraper-off tok/s on a
 # tiny CPU engine; asserts the < 1% budget and persists the derived

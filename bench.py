@@ -52,6 +52,17 @@ def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
 
 
+def bucket64(n: int) -> int:
+    """Round ``n`` up to the 64-token prefill grid via the engine's own
+    bucket rounding (serving.engine.prefill_bucket_for), so the bench's
+    engine-sizing math can never drift from the admission path's."""
+    from k8s_llm_monitor_tpu.serving.engine import prefill_bucket_for
+
+    n = max(int(n), 1)
+    ladder = tuple(64 * i for i in range(1, (n + 63) // 64 + 1))
+    return prefill_bucket_for(n, ladder)
+
+
 # Approximate chip peaks for utilization reporting, keyed by substrings of
 # jax Device.device_kind.  (bf16 matmul TFLOP/s, HBM GB/s.)
 CHIP_PEAKS = {
@@ -881,7 +892,7 @@ def mesh_leg(cfg, params) -> dict:
                              os.environ.get("BENCH_CONCURRENCY", "100")))
     m_slots = int(os.environ.get("BENCH_MESH_SLOTS", "32"))
     cap = m_len + m_gen + 1
-    bucket = int(np.ceil(m_len / 64) * 64)
+    bucket = bucket64(m_len)
     ecfg = EngineConfig(
         max_slots=m_slots,
         num_blocks=m_slots * ((cap + 15) // 16) + 16,
@@ -1002,7 +1013,7 @@ def overlap_leg(cfg, params) -> dict:
         num_blocks=o_slots * ((cap + 15) // 16) + 16,
         block_size=16,
         max_blocks_per_seq=(cap + 15) // 16,
-        prefill_buckets=(int(np.ceil(o_len / 64) * 64),),
+        prefill_buckets=(bucket64(o_len),),
         max_prefills_per_step=min(16, o_slots),
         max_admission_rounds=8,
         decode_steps_per_iter=int(os.environ.get("BENCH_DECODE_STEPS", "8")),
@@ -1147,6 +1158,176 @@ def tier_admission_leg(cfg, params) -> dict:
     }
 
 
+def long_prefill_leg(cfg, params) -> dict:
+    """Flash paged prefill (ops/pallas_attention.flash_prefill_attention):
+    flash-vs-dense TTFT at long prompt lengths, the chunked-vs-single-
+    bucket crossover, a quantized-pool variant, and an analytic
+    peak-live-bytes proxy for the attention intermediates.
+
+    Dense prefill materializes the [S, T] score matrix and (on the chunk
+    path) re-gathers the whole prefix every round, so its transient
+    footprint grows with context; flash streams K/V pages through a
+    fixed double-buffered VMEM window.  A dense leg is skipped — with
+    the byte math recorded as the reason — when its analytic peak
+    exceeds the dense budget (the paged pool bytes on TPU; relaxed by
+    BENCH_PREFILL_DENSE_HEADROOM in the CPU dryrun so the short legs
+    still measure dense while the longest leg exercises the same skip
+    branch a 32k prompt does on the chip).  The longest flash-only leg
+    is the served-where-dense-cannot evidence.
+
+    ``BENCH_PREFILL_LENS`` / ``BENCH_PREFILL_CHUNK`` override the
+    platform defaults (TPU: 2048,8192,32768 over a 512 chunk bucket;
+    dryrun: 128,256,512 over 128 — interpret-mode Pallas is slow, so
+    the dryrun lengths only validate the plumbing, not the speedup).
+    """
+    import numpy as np
+    import jax
+
+    from k8s_llm_monitor_tpu.serving.engine import (
+        EngineConfig,
+        GenerationRequest,
+        InferenceEngine,
+        SamplingParams,
+    )
+
+    dev = jax.devices()[0]
+    on_tpu = dev.platform == "tpu"
+    lens = tuple(int(x) for x in os.environ.get(
+        "BENCH_PREFILL_LENS",
+        "2048,8192,32768" if on_tpu else "128,256,512").split(","))
+    gen = int(os.environ.get("BENCH_PREFILL_MAX_TOKENS", "4"))
+    chunk_bucket = bucket64(int(os.environ.get(
+        "BENCH_PREFILL_CHUNK", "512" if on_tpu else "128")))
+    dense_headroom = float(os.environ.get(
+        "BENCH_PREFILL_DENSE_HEADROOM", "1.0" if on_tpu else "5.0"))
+    bs = 16
+    kvh = cfg.num_kv_heads
+    d = cfg.head_dim or cfg.hidden_size // cfg.num_heads
+    rng = np.random.default_rng(11)
+
+    def geometry(length: int) -> tuple[int, int]:
+        cap = length + gen + 1
+        bps = (cap + bs - 1) // bs
+        return bps, bps + 17        # +17: null block + decode headroom
+
+    def pool_bytes(length: int) -> int:
+        _, nb = geometry(length)
+        # f32 pool in the dryrun / bf16 on TPU; the proxy only needs the
+        # two engines to agree, and they share one EngineConfig.
+        el = 2 if on_tpu else 4
+        return nb * bs * kvh * d * 2 * el
+
+    def dense_peak_bytes(length: int) -> int:
+        bps, _ = geometry(length)
+        t_pad = bps * bs
+        s_b = chunk_bucket if length > chunk_bucket else bucket64(length)
+        # [1, H, S, T] f32 scores + the gathered [T, KVH, D] k/v pair
+        # (f32 compute) — per layer, transient, but peak-live.
+        return (cfg.num_heads * s_b * t_pad * 4
+                + 2 * t_pad * kvh * d * 4)
+
+    # Flash peak-live: double-buffered K and V window slabs in VMEM
+    # (2 slots x W=8 pages x block_size tokens x head_dim lanes, f32).
+    flash_window_bytes = 2 * 2 * (8 * bs) * d * 4
+
+    def build(path: str, length: int, buckets, kv_dtype: str = "auto"):
+        bps, nb = geometry(length)
+        ecfg = EngineConfig(
+            max_slots=2, num_blocks=nb, block_size=bs,
+            max_blocks_per_seq=bps, prefill_buckets=buckets,
+            max_prefills_per_step=1, max_admission_rounds=2,
+            decode_steps_per_iter=2, prefix_cache_entries=0,
+            prefill_path=path, kv_dtype=kv_dtype)
+        return InferenceEngine(cfg, params, ecfg, eos_id=-1)
+
+    def measure_ttft(eng, length: int, tag: str) -> float:
+        prompt = [int(t) for t in
+                  rng.integers(4, cfg.vocab_size - 4, size=length)]
+        eng.generate([prompt], SamplingParams(max_tokens=2))  # warm compiles
+        eng.submit(GenerationRequest(
+            request_id=tag, prompt_ids=prompt,
+            sampling=SamplingParams(max_tokens=gen)))
+        while eng.has_work:
+            eng.step()
+        res = eng.poll(tag)
+        assert res is not None and res.finish_reason != "error", tag
+        return res.ttft_s * 1e3
+
+    out: dict = {
+        "prefill_lens": list(lens),
+        "prefill_chunk_bucket": chunk_bucket,
+        "prefill_dryrun": not on_tpu,
+        "prefill_flash_vmem_window_bytes": flash_window_bytes,
+    }
+    speedup_at: dict[int, float] = {}
+    for length in lens:
+        buckets = ((chunk_bucket,) if length > chunk_bucket
+                   else (bucket64(length),))
+        eng_f = build("flash", length, buckets)
+        assert eng_f.prefill_path == "flash", (
+            "flash prefill not selected — leg would measure dense twice")
+        f_ms = measure_ttft(eng_f, length, f"pf-flash-{length}")
+        out[f"prefill_flash_ttft_ms_{length}"] = round(f_ms, 2)
+        out[f"prefill_flash_buckets_{length}"] = list(
+            eng_f.ecfg.prefill_buckets)
+        del eng_f
+
+        d_peak, pool = dense_peak_bytes(length), pool_bytes(length)
+        out[f"prefill_dense_peak_bytes_{length}"] = d_peak
+        out[f"prefill_pool_bytes_{length}"] = pool
+        if d_peak > pool * dense_headroom:
+            reason = (f"analytic dense peak {d_peak} B > "
+                      f"{dense_headroom:g}x pool {pool} B")
+            out[f"prefill_dense_skip_{length}"] = reason
+            log(f"prefill leg {length}: flash {f_ms:.1f} ms; "
+                f"dense SKIPPED ({reason})")
+            continue
+        eng_d = build("dense", length, buckets)
+        d_ms = measure_ttft(eng_d, length, f"pf-dense-{length}")
+        del eng_d
+        ratio = d_ms / max(f_ms, 1e-9)
+        speedup_at[length] = ratio
+        out[f"prefill_dense_ttft_ms_{length}"] = round(d_ms, 2)
+        out[f"prefill_speedup_{length}"] = round(ratio, 3)
+        log(f"prefill leg {length}: flash {f_ms:.1f} ms vs dense "
+            f"{d_ms:.1f} ms ({ratio:.2f}x)")
+
+    if speedup_at:
+        top = max(speedup_at)
+        out["prefill_speedup_max_len"] = round(speedup_at[top], 3)
+        out["prefill_speedup_max_len_tokens"] = top
+
+    # Chunked-vs-single-bucket crossover: first length long enough to
+    # chunk but short enough that the flash bucket auto-extension can't
+    # lift it back to a single round (capacity < 4096 tokens).
+    lx = next((n for n in lens
+               if n > chunk_bucket and n + gen + 1 + bs < 4096), None)
+    if lx is not None:
+        eng_s = build("flash", lx, (bucket64(lx),))
+        s_ms = measure_ttft(eng_s, lx, f"pf-single-{lx}")
+        del eng_s
+        c_ms = out[f"prefill_flash_ttft_ms_{lx}"]
+        out["prefill_crossover_len"] = lx
+        out["prefill_single_bucket_ttft_ms"] = round(s_ms, 2)
+        out["prefill_chunked_ttft_ms"] = c_ms
+        out["prefill_crossover_winner"] = (
+            "single" if s_ms <= c_ms else "chunked")
+        log(f"prefill crossover @{lx}: single-bucket {s_ms:.1f} ms vs "
+            f"chunked {c_ms:.1f} ms")
+
+    # Quantized-pool variant: in-kernel dequant from the int8 pool.
+    lq = lens[0]
+    try:
+        eng_q = build("flash", lq, (bucket64(lq),), kv_dtype="int8")
+        q_ms = measure_ttft(eng_q, lq, f"pf-quant-{lq}")
+        del eng_q
+        out["prefill_quant_flash_ttft_ms"] = round(q_ms, 2)
+        log(f"prefill quant (int8 pool) @{lq}: flash {q_ms:.1f} ms")
+    except Exception as exc:  # noqa: BLE001 — extras never fail the bench
+        log(f"prefill quant variant skipped: {exc}")
+    return out
+
+
 def main() -> None:
     t0 = time.monotonic()
     cache_was_warm = CACHE_DIR.is_dir() and any(CACHE_DIR.iterdir())
@@ -1231,6 +1412,20 @@ def main() -> None:
         }))
         return
 
+    if os.environ.get("BENCH_PREFILL_ONLY", "0") == "1":
+        # `make bench-prefill`: flash-vs-dense long-prefill TTFT, the
+        # chunked-vs-single-bucket crossover, and the longest flash-only
+        # length the dense path's transient footprint cannot serve.
+        stats = long_prefill_leg(cfg, params)
+        print(json.dumps({
+            "metric": "prefill_flash_vs_dense_ttft",
+            "value": stats.get("prefill_speedup_max_len", 0.0),
+            "unit": "x",
+            "extras": {"model": model_name, "platform": dev.platform,
+                       **stats},
+        }))
+        return
+
     if os.environ.get("BENCH_MESH_ONLY", "0") == "1":
         # `make bench-mesh`: just the TP-mesh leg.  Dryrun on the forced
         # 8-host-device CPU mesh in CI; measured on a real slice.
@@ -1255,14 +1450,14 @@ def main() -> None:
     # Prompt bucket hugs the prompt length (rounded to the 64-lane sublane
     # multiple; 192 itself is 1.5 * 128 and MXU-friendly): minimal padding
     # waste in the prefill calls that dominate TTFT.
-    bucket = int(np.ceil(prompt_len / 64) * 64)
+    bucket = bucket64(prompt_len)
     seq_cap = prompt_len + max_tokens + 1
     # Shared-prefix leg geometry: diagnosis queries share the system
     # preamble + evidence prefix (monitor/analysis.py), modeled as 2/3 of
     # the prompt; the suffix bucket keeps hit-round prefills suffix-sized.
     shared_len = int(os.environ.get(
         "BENCH_SHARED_PREFIX_LEN", str((2 * prompt_len // 3) // 16 * 16)))
-    suffix_bucket = int(np.ceil(max(prompt_len - shared_len, 16) / 64) * 64)
+    suffix_bucket = bucket64(max(prompt_len - shared_len, 16))
     ecfg = EngineConfig(
         max_slots=int(os.environ.get("BENCH_SLOTS", "128")),
         num_blocks=int(os.environ.get("BENCH_BLOCKS", "2200")),

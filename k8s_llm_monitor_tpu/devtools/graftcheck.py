@@ -41,9 +41,11 @@ def main(argv: list[str] | None = None) -> int:
     parser.add_argument("--trace", action="store_true",
                         help="also run the trace-time guards (jit-compiles "
                              "a tiny engine on CPU; slower)")
-    parser.add_argument("--trace-paths", default="gather,fused,mesh,quant",
-                        help="comma-separated decode paths for --trace "
-                             "(default: gather,fused,mesh,quant)")
+    parser.add_argument("--trace-paths",
+                        default="gather,fused,mesh,quant,flash_prefill",
+                        help="comma-separated engine paths for --trace "
+                             "(default: gather,fused,mesh,quant,"
+                             "flash_prefill)")
     parser.add_argument("--list-rules", action="store_true",
                         help="print the AST rules and exit")
     args = parser.parse_args(argv)

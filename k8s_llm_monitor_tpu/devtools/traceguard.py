@@ -50,8 +50,13 @@ FORBIDDEN_PRIMITIVES = frozenset({
 #: "overlap" is the mesh engine with the hand-staged reduce-scatter/
 #: all-gather decode schedule forced on (parallel/overlap.py) — the mesh
 #: path itself pins tp_overlap="off" so the GSPMD reference program stays
-#: gated alongside the overlap one.
-DEFAULT_PATHS = ("gather", "fused", "mesh", "quant", "overlap")
+#: gated alongside the overlap one; "flash_prefill" forces the flash
+#: paged-prefill kernel (prefill_path="flash", interpreter on CPU) so the
+#: prefill/chunk/verify programs run the tiled online-softmax kernel and
+#: are held to the same zero-recompile / donation-rebinding / no-callback
+#: gates as the dense programs.
+DEFAULT_PATHS = ("gather", "fused", "mesh", "quant", "overlap",
+                 "flash_prefill")
 
 
 def force_cpu() -> None:
@@ -140,7 +145,17 @@ def build_engine(decode_path: str = "gather", seed: int = 0):
     mesh = None
     kv_dtype = "auto"
     tp_overlap = "off"
-    if decode_path in ("mesh", "overlap"):
+    prefill_path = "auto"
+    if decode_path == "flash_prefill":
+        # Flash paged prefill forced on (interpreter on CPU) while decode
+        # stays on the gather oracle: every prefill/chunk program in the
+        # gated set now traces flash_prefill_attention, and the donated
+        # page pool rebinds through the kernel's pallas_call instead of
+        # the scatter+gather XLA graph.
+        cfg = _tiny_cfg(fused=False)
+        impl = select_decode_impl(cfg=cfg, mode="gather")
+        prefill_path = "flash"
+    elif decode_path in ("mesh", "overlap"):
         from k8s_llm_monitor_tpu.parallel.mesh import MeshConfig, create_mesh
 
         tp = len(jax.devices())
@@ -168,7 +183,7 @@ def build_engine(decode_path: str = "gather", seed: int = 0):
         prefill_buckets=(16, 32), max_prefills_per_step=2,
         max_admission_rounds=2, decode_steps_per_iter=4, max_inflight=2,
         spec_k=0, prefix_cache_entries=0, sample_topk_cap=8,
-        kv_dtype=kv_dtype, tp_overlap=tp_overlap,
+        kv_dtype=kv_dtype, tp_overlap=tp_overlap, prefill_path=prefill_path,
     )
     engine = InferenceEngine(cfg, params, engine_cfg=ec, eos_id=-1,
                              attn_impl=impl, mesh=mesh)
@@ -359,6 +374,7 @@ class PathReport:
     donated_fsm_rebound: bool = True
     donated_scales_rebound: bool = True
     kv_quant: str = ""
+    prefill_path: str = "dense"
 
     @property
     def ok(self) -> bool:
@@ -438,6 +454,7 @@ def check_path(decode_path: str) -> PathReport:
         donated_fsm_rebound=engine._fsm_state is not fsm_before,
         donated_scales_rebound=scales_rebound,
         kv_quant=engine.kv_quant,
+        prefill_path=engine.prefill_path,
     )
     return report
 

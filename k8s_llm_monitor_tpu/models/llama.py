@@ -398,6 +398,17 @@ def is_fused_quant_decode_impl(attn_impl) -> bool:
                            "quant_kv", False))
 
 
+def is_flash_prefill_impl(attn_impl) -> bool:
+    """True when ``attn_impl`` is the flash paged-prefill kernel
+    (ops/pallas_attention.py:flash_prefill_attention — tiled online
+    softmax reading K/V straight from the pool, scale planes as kwargs
+    for quantized pools).  Survives a functools.partial wrap (CPU runs
+    bind interpret=True that way)."""
+    return bool(getattr(attn_impl, "flash_prefill", False)
+                or getattr(getattr(attn_impl, "func", None),
+                           "flash_prefill", False))
+
+
 def _expert_weights(p: Params, dtype, act_quant: bool = False):
     """Expert kernel stack for einsum use: bf16 passthrough, or the int8
     stack (cast fuses into the MXU operand read) + its [E, out] scales."""
@@ -854,7 +865,21 @@ def _prefill_impl(
                                 valid)
         new_k.append(pk)
         new_v.append(pv)
-        if attend_to_pages and paged_attn_fn is not None and not quant:
+        if paged_attn_fn is not None and is_flash_prefill_impl(paged_attn_fn):
+            # Flash paged prefill: the scatter above already wrote this
+            # chunk's K/V into the pages, so fresh prefill (positions
+            # start at 0) and continuation chunks are the same kernel
+            # call — no gather_pages round-trip, no [S, T] score matrix.
+            # Quantized pools hand the kernel their scale planes and
+            # dequantize in-kernel; the pool never widens in HBM.
+            if quant:
+                attn = paged_attn_fn(q, pk, pv, block_tables,
+                                     positions[:, 0], lengths,
+                                     k_scale=psk, v_scale=psv)
+            else:
+                attn = paged_attn_fn(q, pk, pv, block_tables,
+                                     positions[:, 0], lengths)
+        elif attend_to_pages and paged_attn_fn is not None and not quant:
             # Page-streaming path (Pallas verify kernel): queries are
             # contiguous at positions[:, 0] + i, which both verify_step
             # and prefill_chunk guarantee.  (select_verify_impl returns
@@ -911,6 +936,8 @@ def prefill(
     lengths: jnp.ndarray,
     pages: KVPages,
     block_tables: jnp.ndarray,
+    *,
+    attn_impl=None,
 ) -> tuple[jnp.ndarray, KVPages]:
     """Ingest padded prompts, writing K/V into the paged cache.
 
@@ -919,6 +946,10 @@ def prefill(
       lengths: [B] int32 true prompt lengths (0 = inactive lane).
       pages: paged KV cache.
       block_tables: [B, max_blocks] int32.
+      attn_impl: optional flash paged-prefill kernel (ops/attention.py:
+        select_prefill_impl); None = dense in-flight attention.  The
+        scatter-before-attention order makes the two equivalent: the
+        pages already hold exactly this call's K/V when attention runs.
 
     Returns:
       (last_logits [B, V] float32, updated pages)
@@ -927,7 +958,8 @@ def prefill(
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32), (B, S))
     valid = positions < lengths[:, None]
     return _prefill_impl(params, cfg, tokens, positions, valid, lengths,
-                         lengths, pages, block_tables, attend_to_pages=False)
+                         lengths, pages, block_tables, attend_to_pages=False,
+                         paged_attn_fn=attn_impl)
 
 
 def prefill_chunk(
@@ -938,6 +970,8 @@ def prefill_chunk(
     lengths: jnp.ndarray,
     pages: KVPages,
     block_tables: jnp.ndarray,
+    *,
+    attn_impl=None,
 ) -> tuple[jnp.ndarray, KVPages]:
     """Continuation prefill: ingest a chunk of a prompt whose first ``start``
     tokens are already in the paged cache.
@@ -953,6 +987,8 @@ def prefill_chunk(
       start: [B] int32 — tokens already in the cache for each sequence.
       lengths: [B] int32 — valid tokens in this chunk (0 = inactive lane).
       pages / block_tables: paged cache state.
+      attn_impl: optional flash paged-prefill kernel — skips the dense
+        ``gather_pages`` prefix materialization entirely.
 
     Returns:
       (last-chunk-token logits [B, V] float32, updated pages)
@@ -963,7 +999,7 @@ def prefill_chunk(
     valid = offs[None, :] < lengths[:, None]
     return _prefill_impl(params, cfg, tokens, positions, valid, lengths,
                          start + lengths, pages, block_tables,
-                         attend_to_pages=True)
+                         attend_to_pages=True, paged_attn_fn=attn_impl)
 
 
 def verify_step(

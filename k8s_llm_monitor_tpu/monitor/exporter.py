@@ -318,6 +318,26 @@ def _engine_metrics(w: _Writer, engine) -> None:
              "(~0 when dispatch-ahead hides device latency)",
              [("", round(getattr(engine, "decode_host_gap_ms", 0.0), 4))])
 
+    # Prefill fast-path attribution, mirroring the decode trio: which
+    # path the engine selected (flash paged-prefill kernel vs dense XLA),
+    # how long prefill calls take, and which bucket sizes production
+    # actually dispatches (the 4096/8192 rungs exist only on flash).
+    ppath = getattr(engine, "prefill_path", "dense")
+    w.metric("engine_prefill_path_info", "gauge",
+             "Selected prefill attention path (1 = active)",
+             [(f'{{path="{ppath}"}}', 1)])
+    w.metric("engine_prefill_attn_ms", "gauge",
+             "EMA of per-prefill-call wall time (dispatch to reconcile), "
+             "admission and chunk rounds alike",
+             [("", round(getattr(engine, "prefill_attn_ms", 0.0), 4))])
+    bucket_rounds = getattr(engine, "prefill_bucket_rounds", {})
+    if bucket_rounds:
+        w.metric("engine_prefill_bucket_rounds_total", "counter",
+                 "Prefill rounds dispatched per bucket size (admission "
+                 "and chunk rounds)",
+                 [(f'{{bucket="{b}"}}', n)
+                  for b, n in sorted(bucket_rounds.items())])
+
     # Prometheus histogram: cumulative buckets + sum + count.
     cumulative = 0
     samples = []

@@ -529,3 +529,155 @@ def select_decode_impl(platform: str | None = None, cfg=None, mesh=None,
         # when both shard their kv-head axis.
         return paged_decode_attention
     return select_attn_impl(platform, cfg=cfg, mesh=mesh)
+
+
+def make_tp_flash_prefill(mesh, cfg, interpret: bool = False,
+                          kv_quant: str = ""):
+    """Flash paged prefill under a GSPMD mesh, via ``shard_map``.
+
+    Same TP story as ``make_tp_paged_attention``: the pages shard on
+    kv-head boundaries, page ids stay GLOBAL (every chip reads the same
+    block-table rows and its own head-slice of each page), queries shard
+    their head axis, and no collective is needed — each shard's kernel
+    output is exactly its o-projection input.  The per-shard kernel sees
+    KVH/tp groups and H/tp heads, so the heads-per-group ratio (and the
+    group-major q reshape) is invariant under the split.
+
+    ``kv_quant`` adds the scale planes, sharded exactly with the pages
+    (SpecLayout.kv_scales: the kv-heads axis splits when the fused lane
+    dim does).
+    """
+    import functools
+
+    from jax.sharding import PartitionSpec as P
+
+    from k8s_llm_monitor_tpu.ops.pallas_attention import (
+        flash_prefill_attention,
+    )
+    from k8s_llm_monitor_tpu.parallel.mesh import shard_map_compat
+
+    qspec = P(None, None, "model", None)       # query heads over TP
+    pspec = P(None, None, "model")             # fused kv lanes / scale heads
+    tspec = P(None, None)                      # block tables: global ids
+
+    if kv_quant:
+        @functools.partial(
+            shard_map_compat, mesh=mesh,
+            in_specs=(qspec, pspec, pspec, pspec, pspec, tspec, P(None),
+                      P(None)),
+            out_specs=qspec, check_replication=False)
+        def _attn_sharded(q, k_pages, v_pages, k_scale, v_scale, table,
+                          start, lengths):
+            return flash_prefill_attention(
+                q, k_pages, v_pages, table, start, lengths,
+                k_scale=k_scale, v_scale=v_scale, interpret=interpret)
+
+        def attn(q, k_pages, v_pages, table, start, lengths, *,
+                 k_scale, v_scale):
+            return _attn_sharded(q, k_pages, v_pages, k_scale, v_scale,
+                                 table, start, lengths)
+    else:
+        @functools.partial(
+            shard_map_compat, mesh=mesh,
+            in_specs=(qspec, pspec, pspec, tspec, P(None), P(None)),
+            out_specs=qspec, check_replication=False)
+        def _attn_sharded(q, k_pages, v_pages, table, start, lengths):
+            return flash_prefill_attention(
+                q, k_pages, v_pages, table, start, lengths,
+                interpret=interpret)
+
+        def attn(q, k_pages, v_pages, table, start, lengths):
+            return _attn_sharded(q, k_pages, v_pages, table, start, lengths)
+
+    attn.flash_prefill = True
+    return attn
+
+
+def select_prefill_impl(platform: str | None = None, cfg=None, mesh=None,
+                        mode: str = "auto", kv_quant: str = ""):
+    """Pick the prefill-family attention path (fresh / chunk / verify).
+
+    ``mode`` (EngineConfig.prefill_path / K8SLLM_PREFILL_PATH env):
+      * ``"auto"``  — the flash paged-prefill kernel
+        (ops/pallas_attention.py:flash_prefill_attention) on TPU when the
+        geometry passes; the dense XLA path everywhere else.
+      * ``"flash"`` — force the kernel (interpreter off-TPU; parity tests,
+        traceguard, and the bench's flash legs).  Raises when the model or
+        mesh can't take it rather than silently falling back.
+      * ``"dense"`` — force the dense XLA oracle: in-flight
+        ``causal_attention`` for fresh prefill, ``gather_pages`` + dense
+        attention for chunks and verify.
+
+    ``kv_quant`` ("int8"/"fp8", EngineConfig.kv_dtype) only changes the
+    mesh wrapper's signature — the kernel itself keys on the scale planes
+    it is handed and dequantizes in-kernel, so the quantized pool never
+    widens in HBM (the dense chunk path dequantizes the full gathered
+    prefix instead).
+
+    Returns ``None`` for the dense path (models/llama.py keeps its
+    existing branches — the correctness oracle every flash output is
+    tested against) or an impl marked ``is_flash_prefill_impl`` with the
+    ``flash_prefill_attention`` calling convention.
+    """
+    import functools
+    import logging
+
+    logger = logging.getLogger("k8s_llm_monitor_tpu.ops")
+    if platform is None:
+        platform = jax.default_backend()
+
+    if mode == "dense":
+        return None
+    if mode not in ("auto", "flash"):
+        raise ValueError(f"unknown prefill_path {mode!r}; expected "
+                         "'auto', 'flash', or 'dense'")
+
+    tp = mesh.shape.get("model", 1) if mesh is not None else 1
+
+    def _flash_ok():
+        if cfg is None or getattr(cfg, "has_attn_extras", False):
+            return False   # softcap / sliding window live only in dense
+        if mesh is not None and (tp < 1 or cfg.num_kv_heads % tp != 0):
+            return False   # pages replicate; dense partitions automatically
+        if platform != "tpu":
+            return True    # interpreter has no lane-alignment constraints
+        # Hardware: the kernel DMAs each kv group's own D-lane slice of
+        # the fused page rows, so the slice offset g*D must itself be
+        # lane-aligned — head_dim must be exactly 128 on top of the
+        # fused-row gate (the decode kernels avoid this by copying whole
+        # [bs, F] rows, which prefill can't afford at KVH x the traffic).
+        return _pallas_geometry_ok(cfg, tp) and cfg.head_dim_ == 128
+
+    def _build():
+        from k8s_llm_monitor_tpu.ops.pallas_attention import (
+            flash_prefill_attention,
+        )
+
+        if mesh is not None:
+            return make_tp_flash_prefill(
+                mesh, cfg, interpret=platform != "tpu", kv_quant=kv_quant)
+        if platform != "tpu":
+            return functools.partial(flash_prefill_attention, interpret=True)
+        return flash_prefill_attention
+
+    if mode == "flash":
+        if not _flash_ok():
+            raise ValueError(
+                "prefill_path='flash' but the model/mesh can't take the "
+                "flash kernel (attn extras, head_dim != 128 on TPU, or a "
+                "TP degree that doesn't divide the KV heads); use "
+                "prefill_path='auto' for gated selection")
+        return _build()
+
+    # auto: flash on TPU when the geometry allows; CPU always stays dense
+    # (the interpreter would be a de-optimization, not a fast path) and
+    # remains the oracle the flash path is diffed against in tests.
+    if platform != "tpu" or not _flash_ok():
+        return None
+    try:
+        return _build()
+    except Exception as exc:  # pragma: no cover - import unavailable
+        logger.warning(
+            "flash prefill kernel unavailable (%s); prefill stays on the "
+            "dense XLA path", exc)
+        return None

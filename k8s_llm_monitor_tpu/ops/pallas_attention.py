@@ -957,3 +957,281 @@ def paged_verify_attention_pallas(
     return _run_paged_attn(q, k_pages, v_pages, block_table,
                            start.astype(jnp.int32),
                            lengths.astype(jnp.int32), interpret)
+
+
+# ---------------------------------------------------------------------------
+# Flash paged prefill: tiled online softmax straight off the paged pool
+# ---------------------------------------------------------------------------
+
+
+def _flash_prefill_kernel(
+    TQ,                    # static: query tokens per tile
+    D,                     # static: head dim
+    KVH,                   # static: kv heads (= F // D)
+    qpk,                   # static: query heads per kv group
+    quant,                 # static: dequantize-in-kernel from scale planes
+    # scalar prefetch
+    tables_ref,            # [B, NB] int32 block ids
+    starts_ref,            # [B] int32 cached tokens before this chunk
+    qlens_ref,             # [B] int32 valid query tokens (0 = inactive lane)
+    # inputs
+    q_ref,                 # [1, TQ, 1, qpk*D] this (seq, tile, group) q slab
+    k_hbm,                 # [num_blocks, bs, KVH*D] (ANY/HBM, whole array)
+    v_hbm,                 # same
+    *rest,                 # (ks_hbm, vs_hbm,) o_ref
+):
+    """One program: one query tile of one sequence for one kv group.
+
+    Unlike the decode/verify kernels, whose [QS*H, F] block-diagonal query
+    costs KVH x redundant MXU work per extra query row, prefill has TQ up
+    to 128 query rows live at once — so the grid splits the kv-head axis
+    instead (grid = (B, KVH, n_tiles)) and each program DMAs only its own
+    group's D-lane slice of every page row.  The group's qpk query heads
+    stack on the sublane axis ([qpk*TQ, D]), giving dense MXU dots with no
+    cross-head waste at any GQA ratio.
+
+    Scores for a [TQ, W*bs] window tile are reduced into running
+    (max, sum, acc) online-softmax carries — the [S, T] score matrix is
+    never materialized, which is what lets 8k/32k buckets fit where the
+    dense path's [B, H, S, T] float32 logits cannot.
+
+    ``quant``: pages hold int8/fp8 codes; the per-(token, head) scale rows
+    are DMA'd whole ([bs, KVH]) and this group's column is extracted with a
+    one-hot dot (a [1, KVH] x [KVH, W*bs] contraction — never a sub-lane
+    sliced DMA).  K scales factor out of ``q @ k^T`` onto the score tile; V
+    scales fold into the probabilities, exactly the
+    ``_fused_decode_quant_kernel`` convention.
+    """
+    if quant:
+        ks_hbm, vs_hbm, o_ref = rest
+    else:
+        (o_ref,) = rest
+    b = pl.program_id(0)
+    g = pl.program_id(1)                         # kv group this program owns
+    t = pl.program_id(2)                         # query tile index
+    bs = k_hbm.shape[1]
+    NB = tables_ref.shape[1]
+    W = min(_WINDOW, NB)
+    R = qpk * TQ                                 # stacked query rows
+    start = starts_ref[b]
+    qlen = qlens_ref[b]
+
+    # Row r of the stacked [R, D] query tile is head r // TQ at tile-local
+    # offset r % TQ; its causal horizon is the absolute query position.
+    row_off = jax.lax.rem(
+        jax.lax.broadcasted_iota(jnp.int32, (R, 1), 0), TQ)
+    q_bound = start + t * TQ + row_off
+
+    # Pages to stream: everything through this tile's last valid query
+    # position.  Dead tiles (inactive lane, or wholly past qlen) stream
+    # exactly one page so every wait has a matching start; their rows are
+    # garbage the caller never reads.
+    live = (qlen > 0) & (t * TQ < qlen)
+    ctx = jnp.where(live, start + jnp.minimum((t + 1) * TQ, qlen), 1)
+    n_blocks = (ctx + bs - 1) // bs
+    n_windows = (n_blocks + W - 1) // W
+
+    if quant:
+        onehot_g = (jax.lax.broadcasted_iota(jnp.int32, (1, KVH), 1)
+                    == g).astype(jnp.float32)    # picks this group's scales
+
+    qt = q_ref[0, :, 0, :].astype(jnp.float32)   # [TQ, qpk*D]
+    q2 = jnp.concatenate(
+        [qt[:, j * D:(j + 1) * D] for j in range(qpk)], axis=0)  # [R, D]
+
+    def scoped(k_buf, v_buf, sem, ks_buf=None, vs_buf=None, ssem=None):
+        # k_buf/v_buf: [2, W*bs, D] double-buffered page-slice slabs —
+        # only this group's D lanes ever leave HBM.
+        def start_window(slot, w):
+            for i in range(W):
+                j = jnp.minimum(w * W + i, NB - 1)
+                blk = tables_ref[b, j]
+                pltpu.make_async_copy(
+                    k_hbm.at[blk, :, pl.ds(g * D, D)],
+                    k_buf.at[slot, pl.ds(i * bs, bs)],
+                    sem.at[slot, i, 0]).start()
+                pltpu.make_async_copy(
+                    v_hbm.at[blk, :, pl.ds(g * D, D)],
+                    v_buf.at[slot, pl.ds(i * bs, bs)],
+                    sem.at[slot, i, 1]).start()
+                if quant:
+                    pltpu.make_async_copy(
+                        ks_hbm.at[blk], ks_buf.at[slot, pl.ds(i * bs, bs)],
+                        ssem.at[slot, i, 0]).start()
+                    pltpu.make_async_copy(
+                        vs_hbm.at[blk], vs_buf.at[slot, pl.ds(i * bs, bs)],
+                        ssem.at[slot, i, 1]).start()
+
+        def wait_window(slot, w):
+            for i in range(W):
+                j = jnp.minimum(w * W + i, NB - 1)
+                blk = tables_ref[b, j]
+                pltpu.make_async_copy(
+                    k_hbm.at[blk, :, pl.ds(g * D, D)],
+                    k_buf.at[slot, pl.ds(i * bs, bs)],
+                    sem.at[slot, i, 0]).wait()
+                pltpu.make_async_copy(
+                    v_hbm.at[blk, :, pl.ds(g * D, D)],
+                    v_buf.at[slot, pl.ds(i * bs, bs)],
+                    sem.at[slot, i, 1]).wait()
+                if quant:
+                    pltpu.make_async_copy(
+                        ks_hbm.at[blk], ks_buf.at[slot, pl.ds(i * bs, bs)],
+                        ssem.at[slot, i, 0]).wait()
+                    pltpu.make_async_copy(
+                        vs_hbm.at[blk], vs_buf.at[slot, pl.ds(i * bs, bs)],
+                        ssem.at[slot, i, 1]).wait()
+
+        start_window(0, 0)                       # n_windows >= 1 always
+
+        def body(w, carry):
+            m, l, acc = carry
+            slot = jax.lax.rem(w, 2)
+
+            @pl.when(w + 1 < n_windows)
+            def _prefetch():
+                start_window(1 - slot, w + 1)
+
+            wait_window(slot, w)
+            p_idx = (w * (W * bs)
+                     + jax.lax.broadcasted_iota(jnp.int32, (1, W * bs), 1))
+            valid = p_idx <= q_bound             # causal, absolute positions
+            kblk = k_buf[slot].astype(jnp.float32)          # [W*bs, D]
+            vblk = v_buf[slot].astype(jnp.float32)
+            s = jax.lax.dot_general(
+                q2, kblk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)         # [R, W*bs]
+            if quant:
+                ks_g = jax.lax.dot_general(
+                    onehot_g, ks_buf[slot], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)     # [1, W*bs]
+                vs_g = jax.lax.dot_general(
+                    onehot_g, vs_buf[slot], (((1,), (1,)), ((), ())),
+                    preferred_element_type=jnp.float32)
+                s = s * ks_g
+            s = jnp.where(valid, s, NEG_INF)
+            m_cur = jnp.max(s, axis=-1, keepdims=True)
+            m_new = jnp.maximum(m, m_cur)
+            alpha = jnp.exp(m - m_new)
+            p = jnp.exp(s - m_new)
+            l_new = alpha * l + jnp.sum(p, axis=-1, keepdims=True)
+            if quant:
+                p = p * vs_g
+            pv = jax.lax.dot_general(
+                p, vblk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)         # [R, D]
+            return m_new, l_new, alpha * acc + pv
+
+        m0 = jnp.full((R, 1), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((R, 1), jnp.float32)
+        acc0 = jnp.zeros((R, D), jnp.float32)
+        m, l, acc = jax.lax.fori_loop(0, n_windows, body, (m0, l0, acc0))
+        # Position 0 is always causally visible, so l > 0 on every row;
+        # the guard only hardens against a fully-degenerate table.
+        out = acc / jnp.where(l > 0.0, l, 1.0)
+        for j in range(qpk):
+            o_ref[0, :, 0, j * D:(j + 1) * D] = out[
+                j * TQ:(j + 1) * TQ].astype(o_ref.dtype)
+
+    scope = dict(
+        k_buf=pltpu.VMEM((2, W * bs, D), k_hbm.dtype),
+        v_buf=pltpu.VMEM((2, W * bs, D), v_hbm.dtype),
+        sem=pltpu.SemaphoreType.DMA((2, W, 2)),
+    )
+    if quant:
+        scope.update(
+            ks_buf=pltpu.VMEM((2, W * bs, KVH), jnp.float32),
+            vs_buf=pltpu.VMEM((2, W * bs, KVH), jnp.float32),
+            ssem=pltpu.SemaphoreType.DMA((2, W, 2)),
+        )
+    pl.run_scoped(scoped, **scope)
+
+
+def flash_prefill_attention(
+    q: jnp.ndarray,
+    k_pages: jnp.ndarray,
+    v_pages: jnp.ndarray,
+    block_table: jnp.ndarray,
+    start: jnp.ndarray,
+    lengths: jnp.ndarray,
+    *,
+    k_scale: jnp.ndarray | None = None,
+    v_scale: jnp.ndarray | None = None,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    """Causal prefill attention reading K/V straight from the paged pool.
+
+    Query token ``i`` of sequence ``b`` sits at absolute position
+    ``start[b] + i`` and attends causally through itself — the same
+    geometry contract as ``paged_verify_attention_pallas``, but tiled for
+    bucket-sized S: queries split into TQ-token tiles (largest power of two
+    <= 128 dividing S), scores reduce through online-softmax carries, and
+    the ``[S, T]`` score matrix is never materialized.  The chunk's own K/V
+    must already be scattered into the pages (models/llama.py scatters
+    before attention), which is what collapses fresh prefill
+    (``start = 0``), continuation chunks, and spec verify into one kernel.
+
+    ``k_scale``/``v_scale`` ([num_blocks, bs, KVH] float32) switch on
+    in-kernel dequantization of int8/fp8 pages — the quantized pool never
+    widens in HBM.
+
+    Args:
+      q: [B, S, H, D] (S = prefill bucket).
+      start: [B] int32 tokens already cached before this chunk (0 = fresh).
+      lengths: [B] int32 valid query tokens (0 = inactive lane; its rows
+        compute against the null block and are discarded by the caller).
+
+    Returns:
+      [B, S, H, D] in q.dtype.
+    """
+    B, S, H, D = q.shape
+    nblk, bs, F = k_pages.shape
+    assert F % D == 0 and D <= 128, (F, D)
+    KVH = F // D
+    assert H % KVH == 0, (H, KVH)
+    qpk = H // KVH
+    quant = k_scale is not None
+    TQ = next(tt for tt in (128, 64, 32, 16, 8, 4, 2, 1) if S % tt == 0)
+    NQ = S // TQ
+
+    # Head order is group-major (head h serves kv group h // qpk), so a
+    # plain reshape lands each group's qpk heads on contiguous D-lane
+    # slices of its [B, S, KVH, qpk*D] slab.
+    qg = (q * (D ** -0.5)).reshape(B, S, KVH, qpk * D)
+
+    def qmap(b, g, t, *_):
+        return (b, t, g, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=3,
+        grid=(B, KVH, NQ),
+        in_specs=[
+            pl.BlockSpec((1, TQ, 1, qpk * D), qmap),
+            pl.BlockSpec(memory_space=pl.ANY),   # K pages stay in HBM
+            pl.BlockSpec(memory_space=pl.ANY),   # V pages stay in HBM
+        ] + ([pl.BlockSpec(memory_space=pl.ANY)] * 2 if quant else []),
+        out_specs=pl.BlockSpec((1, TQ, 1, qpk * D), qmap),
+    )
+
+    operands = [block_table, start.astype(jnp.int32),
+                lengths.astype(jnp.int32), qg, k_pages, v_pages]
+    if quant:
+        operands += [k_scale, v_scale]
+    out = pl.pallas_call(
+        functools.partial(_flash_prefill_kernel, TQ, D, KVH, qpk, quant),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, S, KVH, qpk * D), q.dtype),
+        compiler_params=_CompilerParams(
+            # Programs are fully independent (read-only pages, disjoint
+            # output tiles): megacore may split any grid axis.
+            dimension_semantics=("parallel", "parallel", "parallel"),
+        ),
+        interpret=interpret,
+    )(*operands)
+    return out.reshape(B, S, H, D)
+
+
+# Marker consumed by models/llama.py:is_flash_prefill_impl — the prefill
+# family routes all three geometries (fresh/chunk/verify) through this
+# calling convention, passing scale planes for quantized pools.
+flash_prefill_attention.flash_prefill = True

@@ -29,7 +29,11 @@ attention Q-heads remain fully sharded either way.
 Page tables and context lengths are NEVER sharded: block ids are global
 (serving/kv_cache.py allocates them host-side), every chip indexes the
 same table rows and reads its own head-slice of each page.  That is the
-invariant that lets ``BlockAllocator``/``PrefixCache`` stay mesh-agnostic.
+invariant that lets ``BlockAllocator``/``PrefixCache`` stay mesh-agnostic,
+and what lets the Pallas paged kernels — decode and flash prefill
+(ops/attention.py ``make_tp_paged_attention`` / ``make_tp_flash_prefill``)
+— run per-shard under ``shard_map`` with no collective: each shard walks
+the same block table over its own kv-head slice of the pool.
 """
 
 from __future__ import annotations

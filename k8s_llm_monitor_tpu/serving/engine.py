@@ -157,6 +157,23 @@ class GenerationResult:
     error: str = ""            # set when finish_reason == "error"
 
 
+def prefill_bucket_for(n: int, buckets) -> int:
+    """Smallest bucket in ``buckets`` covering ``n`` tokens — THE bucket
+    rounding, shared by the engine's admission path (``_bucket``) and by
+    bench.py's engine-sizing math, so the two can't silently disagree
+    about which bucket a prompt lands in (they once computed it with
+    independent formulas).  ``buckets`` must be ascending; ``n`` past the
+    top bucket raises — longer prompts go through chunked prefill, never
+    silent clamping."""
+    for b in buckets:
+        if n <= b:
+            return b
+    raise ValueError(
+        f"{n} tokens exceeds the largest prefill bucket "
+        f"{buckets[-1]} — chunk before bucketing"
+    )
+
+
 @dataclasses.dataclass
 class EngineConfig:
     max_slots: int = 16
@@ -179,6 +196,13 @@ class EngineConfig:
     # compatible single TPU chip, split/gather otherwise; "fused",
     # "pallas", "gather" force a path.  K8SLLM_DECODE_PATH overrides.
     decode_path: str = "auto"
+    # Prefill-family attention path (ops/attention.py:select_prefill_impl):
+    # "auto" = the flash paged-prefill kernel (tiled online softmax reading
+    # K/V straight from the pool) on a compatible TPU chip or mesh, the
+    # dense XLA oracle otherwise; "flash"/"dense" force a path.  Serves
+    # fresh prefill, continuation chunks, and spec verify alike.
+    # K8SLLM_PREFILL_PATH overrides.
+    prefill_path: str = "auto"
     # Resident KV representation (serving/kv_tier.py rung 1): "auto" keeps
     # the model-dtype pool (the flag-selectable fp16/bf16 oracle, same
     # pattern as decode_path); "int8"/"fp8" store pages in the narrow dtype
@@ -429,6 +453,32 @@ class InferenceEngine:
         else:
             raise ValueError(
                 f"unknown kv_dtype {kvd!r} (auto | int8 | fp8)")
+        # Prefill-family attention path, resolved before the bucket ladder
+        # is frozen (and before the mesh seq-divisibility check below sees
+        # it): the flash kernel's geometry gates live in
+        # ops/attention.py:select_prefill_impl; None = dense XLA oracle.
+        from k8s_llm_monitor_tpu.ops.attention import select_prefill_impl
+        pmode = os.environ.get("K8SLLM_PREFILL_PATH",
+                               ec.prefill_path) or "auto"
+        self._prefill_attn = select_prefill_impl(
+            cfg=cfg, mesh=mesh, mode=pmode, kv_quant=self.kv_quant)
+        self.prefill_path = ("flash" if self._prefill_attn is not None
+                             else "dense")
+        if self._prefill_attn is not None:
+            # Cash in the flash win: long prompts chunk in 4096/8192-token
+            # rounds instead of 2048 — fewer chunk rounds per prompt at the
+            # same pool bytes.  Flash-gated because the dense path would
+            # materialize [B, H, S, T] float32 score tensors at these S;
+            # capacity-capped so small engines (tests, traceguard) keep
+            # their ladders byte-for-byte unchanged.
+            cap = min(ec.max_blocks_per_seq,
+                      ec.num_blocks - 1) * ec.block_size
+            extra = tuple(b for b in (4096, 8192)
+                          if b > max(ec.prefill_buckets) and b <= cap)
+            if extra:
+                ec = dataclasses.replace(
+                    ec, prefill_buckets=tuple(ec.prefill_buckets) + extra)
+                self.ecfg = ec
         pages = llama.init_kv_pages(cfg, ec.num_blocks, ec.block_size,
                                     kv_quant=self.kv_quant)
         # Sequence-sharded prefill (SURVEY §7 step 5): on a mesh with a
@@ -574,7 +624,13 @@ class InferenceEngine:
         # Quantized pools drop the dedicated verify kernel: llama's
         # prefill/verify gather branch dequantizes in-program instead
         # (models/llama.py _prefill_impl quant gate).
-        if self.ecfg.spec_k > 0 and not self.kv_quant:
+        if self.ecfg.spec_k > 0 and self._prefill_attn is not None:
+            # Flash prefill serves verify too (identical geometry contract,
+            # all-positions unembed) — including quantized pools, whose
+            # scale planes ride as kwargs.  This lifts the historical
+            # "quant drops the verify kernel" restriction above.
+            self._verify_impl = self._prefill_attn
+        elif self.ecfg.spec_k > 0 and not self.kv_quant:
             from k8s_llm_monitor_tpu.ops.attention import select_verify_impl
 
             self._verify_impl = select_verify_impl(
@@ -582,11 +638,15 @@ class InferenceEngine:
                 max_table_tokens=ec.max_blocks_per_seq * ec.block_size)
         else:
             self._verify_impl = None
+        # Captured by the prefill closures below; None keeps llama's
+        # dense branches (in-flight attention / gather_pages).
+        prefill_attn = self._prefill_attn
 
         def _prefill_sample_fn(params, tokens, lengths, pages, tables,
                                temp, topk, topp, rng):
             logits, pages = llama.prefill(
-                params, cfg, tokens, lengths, pages, tables
+                params, cfg, tokens, lengths, pages, tables,
+                attn_impl=prefill_attn
             )
             first = sample_tokens(
                 rng, logits, temperature=temp, top_k=topk, top_p=topp
@@ -598,7 +658,8 @@ class InferenceEngine:
             # [P, V] argsort nucleus filtering needs (V is 128k on the 8B
             # target — the sort costs more than the unembed).
             logits, pages = llama.prefill(
-                params, cfg, tokens, lengths, pages, tables
+                params, cfg, tokens, lengths, pages, tables,
+                attn_impl=prefill_attn
             )
             return greedy_tokens(logits), pages
 
@@ -608,7 +669,8 @@ class InferenceEngine:
             # its unshared suffix (start = shared tokens, 0 for misses) and
             # samples its first token in the same program.
             logits, pages = llama.prefill_chunk(
-                params, cfg, tokens, start, lengths, pages, tables
+                params, cfg, tokens, start, lengths, pages, tables,
+                attn_impl=prefill_attn
             )
             first = sample_tokens(
                 rng, logits, temperature=temp, top_k=topk, top_p=topp
@@ -618,7 +680,8 @@ class InferenceEngine:
         def _prefill_chunk_greedy_fn(params, tokens, start, lengths, pages,
                                      tables):
             logits, pages = llama.prefill_chunk(
-                params, cfg, tokens, start, lengths, pages, tables
+                params, cfg, tokens, start, lengths, pages, tables,
+                attn_impl=prefill_attn
             )
             return greedy_tokens(logits), pages
 
@@ -629,7 +692,8 @@ class InferenceEngine:
             # shared sampler — greedy lanes take the argmax of the masked
             # logits inside sample_tokens, so constrained-greedy is exact.
             logits, pages = llama.prefill(
-                params, cfg, tokens, lengths, pages, tables
+                params, cfg, tokens, lengths, pages, tables,
+                attn_impl=prefill_attn
             )
             masked = fsm_mask_logits(logits, fstate, ftrans)
             first = sample_tokens(
@@ -641,7 +705,8 @@ class InferenceEngine:
                                          pages, tables, fstate, ftrans,
                                          temp, topk, topp, rng):
             logits, pages = llama.prefill_chunk(
-                params, cfg, tokens, start, lengths, pages, tables
+                params, cfg, tokens, start, lengths, pages, tables,
+                attn_impl=prefill_attn
             )
             masked = fsm_mask_logits(logits, fstate, ftrans)
             first = sample_tokens(
@@ -763,6 +828,14 @@ class InferenceEngine:
         self.decode_host_gap_ms = 0.0
         self.decode_attn_ms = 0.0
         self.decode_sample_ms = 0.0
+        # Prefill fast-path attribution (exporter parity with the decode
+        # trio): prefill_attn_ms is an EMA of per-prefill-call wall time
+        # (dispatch -> reconcile, admission and chunk rounds alike);
+        # prefill_bucket_rounds counts dispatched rounds per bucket size,
+        # so the signals plane can see which buckets production actually
+        # runs (the 4096/8192 rungs exist only on the flash path).
+        self.prefill_attn_ms = 0.0
+        self.prefill_bucket_rounds: dict[int, int] = {}
         # Per-step collective (ICI) share of the TP decode step, estimated
         # by profile_decode_phases() from the measured step time and the
         # ring-all-reduce byte model; 0.0 off-mesh or before profiling.
@@ -1375,13 +1448,7 @@ class InferenceEngine:
         ``n`` must not exceed the largest bucket — longer prompts go through
         chunked prefill, never silent clamping.
         """
-        for b in self.ecfg.prefill_buckets:
-            if n <= b:
-                return b
-        raise ValueError(
-            f"{n} tokens exceeds the largest prefill bucket "
-            f"{self.ecfg.prefill_buckets[-1]} — chunk before bucketing"
-        )
+        return prefill_bucket_for(n, self.ecfg.prefill_buckets)
 
     def _free_slots(self) -> list[int]:
         return [i for i, s in enumerate(self._slots) if s is None]
@@ -2065,6 +2132,8 @@ class InferenceEngine:
             self._pending.extendleft(reversed(requeue))
             return admitted_long > 0
         self._record_dispatch_ok()
+        self.prefill_bucket_rounds[bucket] = (
+            self.prefill_bucket_rounds.get(bucket, 0) + 1)
         if self.prefix_cache is not None:
             for slot_idx, req, blocks, st in batch:
                 self.prefix_cache.register(req.prompt_ids, blocks)
@@ -2200,6 +2269,8 @@ class InferenceEngine:
             self._record_dispatch_failure(exc)
             return False
         self._record_dispatch_ok()
+        self.prefill_bucket_rounds[bucket] = (
+            self.prefill_bucket_rounds.get(bucket, 0) + 1)
         for s in to_register:
             self.prefix_cache.register(s.req.prompt_ids, s.blocks)
         self.prefills += len(lanes)
@@ -3012,6 +3083,13 @@ class InferenceEngine:
                 else 0.9 * self.decode_host_gap_ms + 0.1 * gap_ms)
         if call.kind in ("admit", "chunk"):
             now = time.monotonic()
+            # Per-prefill-call wall time (dispatch -> reconcile), the
+            # prefill twin of decode_host_gap_ms: an EMA across admission
+            # and chunk rounds, surfaced as engine_prefill_attn_ms.
+            pf_ms = max(0.0, now - call.t0) * 1e3
+            self.prefill_attn_ms = (
+                pf_ms if self.prefill_attn_ms == 0.0
+                else 0.9 * self.prefill_attn_ms + 0.1 * pf_ms)
             for s in call.touched:           # chunk calls: drain refcounts
                 s.inflight_chunks -= 1
             rows = (enumerate(call.lanes) if call.kind == "admit"
