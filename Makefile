@@ -13,7 +13,7 @@ SHELL := /bin/bash    # tier1 uses pipefail/PIPESTATUS
         dryrun smoke \
         preflight \
         deploy-agent docker \
-        docker-agent docker-scheduler lint lint-trace clean
+        docker-agent docker-scheduler lint lint-contracts lint-trace clean
 
 run:
 	$(PY) -m k8s_llm_monitor_tpu.cmd.server --cluster fake --port 8081
@@ -199,7 +199,12 @@ lint:               # compileall + graftcheck always; ruff/mypy when installed
 	@if $(PY) -c "import mypy" >/dev/null 2>&1; then \
 	  $(PY) -m mypy --config-file pyproject.toml; \
 	else echo "lint: mypy not installed, skipping (config in pyproject.toml)"; fi
-	$(TEST_ENV) $(PY) -m k8s_llm_monitor_tpu.devtools.graftcheck $(LINT_PATHS)
+	$(TEST_ENV) $(PY) -m k8s_llm_monitor_tpu.devtools.graftcheck \
+	  --dataflow --contracts $(LINT_PATHS)
+
+lint-contracts:     # fast path: contract-drift checks only (no package import)
+	$(TEST_ENV) $(PY) -m k8s_llm_monitor_tpu.devtools.graftcheck \
+	  --contracts k8s_llm_monitor_tpu/devtools/contracts.py
 
 lint-trace:         # lint + trace-time guards (jit-compiles a tiny engine)
 	$(TEST_ENV) $(PY) -m k8s_llm_monitor_tpu.devtools.graftcheck --trace $(LINT_PATHS)

@@ -22,6 +22,35 @@ from typing import Any
 
 import yaml
 
+#: Central registry of every *explicit* project-prefixed env key read
+#: anywhere in the package.  Keys derived generically by ``_apply_env``
+#: (config path ``fleet.role`` -> ``FLEET_ROLE``) are NOT listed — they
+#: are computed from the dataclass tree.  Values are either a
+#: ``Class.field`` the key overrides (validated against the package AST
+#: by ``graftcheck --contracts``) or ``runtime:<module>`` for toggles
+#: with no config field, owned and read by that module.  The env
+#: contract checker enforces: every explicit read is registered, every
+#: entry is read and documented, every target exists.
+ENV_KEYS: dict[str, str] = {
+    # engine kernel-path overrides (env wins over EngineConfig)
+    "K8SLLM_KV_DTYPE": "EngineConfig.kv_dtype",
+    "K8SLLM_PREFILL_PATH": "EngineConfig.prefill_path",
+    "K8SLLM_DECODE_PATH": "EngineConfig.decode_path",
+    "K8SLLM_TP_OVERLAP": "EngineConfig.tp_overlap",
+    # reference-compat aliases (config.go:172-182)
+    "OPENAI_API_KEY": "LLMConfig.api_key",
+    "OPENAI_BASE_URL": "LLMConfig.base_url",
+    # runtime toggles: no config field by design — they must work
+    # before/without a loaded Config (crash paths, chaos drills, tests)
+    "K8SLLM_TRACE_SAMPLE": "runtime:observability/tracing.py",
+    "K8SLLM_TRACE_SEED": "runtime:observability/tracing.py",
+    "K8SLLM_FLIGHT_DIR": "runtime:observability/flight.py",
+    "K8SLLM_FAULTS": "runtime:resilience/faults.py",
+    "K8SLLM_JOURNAL_FSYNC": "runtime:resilience/journal.py",
+    "K8SLLM_LOCKCHECK": "runtime:devtools/lockcheck.py",
+    "K8SLLM_LOCKCHECK_HOLD_MS": "runtime:devtools/lockcheck.py",
+}
+
 
 @dataclass
 class ServerConfig:

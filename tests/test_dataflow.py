@@ -1,0 +1,447 @@
+"""Dataflow lint suite: call graph, reachability, and the three
+interprocedural rules (graftcheck --dataflow).
+
+Every rule gets a seeded-violation positive on a fixture package and a
+clean negative that mirrors the *real* exclusions in the repo (watchdog-
+guarded sleep, fault-injector-tainted delay, timeout-bounded HTTP,
+sanctioned WAL IO) — so the exclusions are provably load-bearing, not
+accidents of the checker.  The final test pins the live package clean.
+"""
+
+from __future__ import annotations
+
+import textwrap
+from pathlib import Path
+
+from k8s_llm_monitor_tpu.devtools import dataflow
+from k8s_llm_monitor_tpu.devtools.dataflow import (
+    analyze_paths, build_index, reachable_from)
+
+REPO_ROOT = Path(__file__).resolve().parents[1]
+PKG_ROOT = REPO_ROOT / "k8s_llm_monitor_tpu"
+
+ENTRIES = (("engine.py", "Engine.step"),)
+
+
+def write_pkg(tmp_path: Path, files: dict[str, str]) -> Path:
+    for rel, src in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src), encoding="utf-8")
+    return tmp_path
+
+
+def run(tmp_path: Path, files: dict[str, str], rule: str,
+        entries=ENTRIES):
+    root = write_pkg(tmp_path, files)
+    return analyze_paths([root], rules=[rule], entries=entries)
+
+
+# -- call graph --------------------------------------------------------------
+
+
+def test_call_graph_resolves_methods_functions_and_imports(tmp_path):
+    root = write_pkg(tmp_path, {
+        "engine.py": """
+            from journal import append_wal
+
+            def helper():
+                append_wal(b"x")
+
+            class Engine:
+                def step(self):
+                    self._drain()
+                    helper()
+
+                def _drain(self):
+                    def flush():
+                        pass
+                    flush()
+            """,
+        "journal.py": """
+            def append_wal(rec):
+                pass
+            """,
+    })
+    idx = build_index([root])
+    roots = [fi for fi in idx.funcs.values() if fi.qual == "Engine.step"]
+    assert len(roots) == 1
+    pred = reachable_from(idx, roots)
+    names = {idx.funcs[q].display for q in pred}
+    # self-method, module function, cross-module import, nested def
+    assert names == {"engine.Engine.step", "engine.Engine._drain",
+                     "engine.helper", "journal.append_wal",
+                     "engine.Engine._drain.<locals>.flush"}
+
+
+def test_call_graph_follows_base_class_methods(tmp_path):
+    root = write_pkg(tmp_path, {
+        "base.py": """
+            class Base:
+                def run(self):
+                    pass
+            """,
+        "engine.py": """
+            from base import Base
+
+            class Engine(Base):
+                def step(self):
+                    self.run()
+            """,
+    })
+    idx = build_index([root])
+    pred = reachable_from(
+        idx, [fi for fi in idx.funcs.values() if fi.qual == "Engine.step"])
+    assert any(idx.funcs[q].display == "base.Base.run" for q in pred)
+
+
+# -- blocking-in-hot-path ----------------------------------------------------
+
+
+def test_blocking_flags_sleep_two_calls_from_entry(tmp_path):
+    findings = run(tmp_path, {
+        "engine.py": """
+            import time
+
+            def backoff():
+                time.sleep(0.5)
+
+            def reconcile():
+                backoff()
+
+            class Engine:
+                def step(self):
+                    reconcile()
+            """,
+    }, "blocking-in-hot-path")
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.rule == "blocking-in-hot-path"
+    assert "time.sleep" in f.message
+    # witness chain walks back to the entry
+    assert "Engine.step" in f.message and "backoff" in f.message
+
+
+def test_blocking_flags_file_io_and_subprocess(tmp_path):
+    findings = run(tmp_path, {
+        "engine.py": """
+            import subprocess
+
+            class Engine:
+                def step(self):
+                    cfg = open("/etc/cfg").read()
+                    subprocess.run(["kubectl", "get", "pods"])
+                    return cfg
+            """,
+    }, "blocking-in-hot-path")
+    assert {m for m in (f.message.split("'")[1] for f in findings)} == {
+        "open (file IO)", "subprocess.run (subprocess)"}
+
+
+def test_blocking_ignores_watchdog_guarded_function(tmp_path):
+    findings = run(tmp_path, {
+        "engine.py": """
+            import time
+
+            class Engine:
+                def step(self):
+                    self._reconcile()
+
+                def _reconcile(self):
+                    if self.watchdog_trips > 0:
+                        time.sleep(0.01)
+            """,
+    }, "blocking-in-hot-path")
+    assert findings == []
+
+
+def test_blocking_ignores_fault_injector_tainted_sleep(tmp_path):
+    findings = run(tmp_path, {
+        "engine.py": """
+            import time
+
+            class Engine:
+                def step(self):
+                    d = self._inj.delay_s("decode.step")
+                    time.sleep(d)
+            """,
+    }, "blocking-in-hot-path")
+    assert findings == []
+
+
+def test_blocking_ignores_timeout_bounded_calls(tmp_path):
+    findings = run(tmp_path, {
+        "engine.py": """
+            from urllib.request import urlopen
+
+            class Engine:
+                def step(self):
+                    urlopen("http://replica/generate", timeout=2.0)
+            """,
+    }, "blocking-in-hot-path")
+    assert findings == []
+
+
+def test_blocking_ignores_sanctioned_wal_module(tmp_path):
+    findings = run(tmp_path, {
+        "engine.py": """
+            from journal import append_wal
+
+            class Engine:
+                def step(self):
+                    append_wal(b"rec")
+            """,
+        "resilience/journal.py": """
+            def append_wal(rec):
+                with open("/tmp/wal", "ab") as fh:
+                    fh.write(rec)
+            """,
+    }, "blocking-in-hot-path")
+    assert findings == []
+
+
+def test_blocking_cold_path_not_flagged(tmp_path):
+    findings = run(tmp_path, {
+        "engine.py": """
+            import time
+
+            class Engine:
+                def step(self):
+                    pass
+
+                def shutdown(self):
+                    time.sleep(1.0)
+            """,
+    }, "blocking-in-hot-path")
+    assert findings == []
+
+
+def test_suppression_comment_silences_dataflow_finding(tmp_path):
+    findings = run(tmp_path, {
+        "engine.py": """
+            import time
+
+            class Engine:
+                def step(self):
+                    time.sleep(1.0)  # graftcheck: disable=blocking-in-hot-path
+            """,
+    }, "blocking-in-hot-path")
+    assert findings == []
+
+
+# -- recompile-hazard --------------------------------------------------------
+
+
+def test_recompile_flags_host_read_in_jit_callee(tmp_path):
+    findings = run(tmp_path, {
+        "kernels.py": """
+            import jax, time
+
+            def scaled(x):
+                return x * time.time()
+
+            @jax.jit
+            def kernel(x):
+                return scaled(x)
+            """,
+    }, "recompile-hazard")
+    assert len(findings) == 1
+    assert "time.time" in findings[0].message
+    assert "kernel" in findings[0].message  # traced-via chain
+
+
+def test_recompile_flags_device_sync_anywhere_in_traced_flow(tmp_path):
+    findings = run(tmp_path, {
+        "kernels.py": """
+            import jax
+
+            @jax.jit
+            def kernel(x):
+                y = x + 1
+                return float(y.item())
+            """,
+    }, "recompile-hazard")
+    assert len(findings) == 1
+    assert "device->host sync" in findings[0].message
+
+
+def test_recompile_flags_mutable_closure_capture(tmp_path):
+    findings = run(tmp_path, {
+        "kernels.py": """
+            import jax
+
+            def build(scale):
+                table = [1.0, 2.0, 4.0]
+
+                def f(x):
+                    return x * table[0]
+
+                return jax.jit(f)
+            """,
+    }, "recompile-hazard")
+    assert len(findings) == 1
+    assert "captures 'table'" in findings[0].message
+
+
+def test_recompile_root_host_read_left_to_astlint(tmp_path):
+    # the direct read in the jit root is astlint's jit-host-read;
+    # the dataflow rule only adds the interprocedural cases
+    findings = run(tmp_path, {
+        "kernels.py": """
+            import jax, time
+
+            @jax.jit
+            def kernel(x):
+                return x * time.time()
+            """,
+    }, "recompile-hazard")
+    assert findings == []
+
+
+def test_recompile_untraced_function_clean(tmp_path):
+    findings = run(tmp_path, {
+        "host.py": """
+            import time
+
+            def collect():
+                return time.time()
+            """,
+    }, "recompile-hazard")
+    assert findings == []
+
+
+# -- lock-order-static -------------------------------------------------------
+
+
+def test_lock_order_flags_nested_with_cycle(tmp_path):
+    findings = run(tmp_path, {
+        "a.py": """
+            from locks import make_lock
+
+            pool_lock = make_lock("pool")
+            sched_lock = make_lock("sched")
+
+            def alloc():
+                with pool_lock:
+                    with sched_lock:
+                        pass
+
+            def evict():
+                with sched_lock:
+                    with pool_lock:
+                        pass
+            """,
+        "locks.py": """
+            def make_lock(name):
+                return object()
+            """,
+    }, "lock-order-static")
+    assert len(findings) == 1
+    assert "pool" in findings[0].message and "sched" in findings[0].message
+
+
+def test_lock_order_flags_cycle_through_call_graph(tmp_path):
+    findings = run(tmp_path, {
+        "a.py": """
+            from locks import make_lock
+
+            pool_lock = make_lock("pool")
+            sched_lock = make_lock("sched")
+
+            def grab_pool():
+                with pool_lock:
+                    pass
+
+            def alloc():
+                with pool_lock:
+                    with sched_lock:
+                        pass
+
+            def evict():
+                with sched_lock:
+                    grab_pool()
+            """,
+        "locks.py": """
+            def make_lock(name):
+                return object()
+            """,
+    }, "lock-order-static")
+    assert len(findings) == 1
+    assert "call into" in findings[0].message
+
+
+def test_lock_order_consistent_order_clean(tmp_path):
+    findings = run(tmp_path, {
+        "a.py": """
+            from locks import make_lock
+
+            pool_lock = make_lock("pool")
+            sched_lock = make_lock("sched")
+
+            def alloc():
+                with pool_lock:
+                    with sched_lock:
+                        pass
+
+            def evict():
+                with pool_lock:
+                    with sched_lock:
+                        pass
+            """,
+        "locks.py": """
+            def make_lock(name):
+                return object()
+            """,
+    }, "lock-order-static")
+    assert findings == []
+
+
+def test_lock_identity_is_scoped_not_textual(tmp_path):
+    # self._lock in two different classes must never unify into one lock
+    findings = run(tmp_path, {
+        "a.py": """
+            from locks import make_lock
+
+            class Pool:
+                def __init__(self):
+                    self._lock = make_lock("pool")
+
+                def use(self, sched):
+                    with self._lock:
+                        sched.use_raw()
+
+            class Sched:
+                def __init__(self):
+                    self._lock = make_lock("sched")
+
+                def use_raw(self):
+                    with self._lock:
+                        pass
+            """,
+        "locks.py": """
+            def make_lock(name):
+                return object()
+            """,
+    }, "lock-order-static")
+    assert findings == []
+
+
+# -- the live repo -----------------------------------------------------------
+
+
+def test_live_package_passes_all_dataflow_rules():
+    findings = analyze_paths([PKG_ROOT])
+    assert findings == [], dataflow.render(findings)
+
+
+def test_hot_entries_exist_and_reach_real_code():
+    idx = build_index([PKG_ROOT])
+    roots = [fi for fi in idx.funcs.values()
+             for (sfx, qual) in dataflow.HOT_ENTRIES
+             if fi.qual == qual
+             and fi.path.replace("\\", "/").endswith(sfx)]
+    # every configured entry resolves to exactly one real function
+    assert len(roots) == len(dataflow.HOT_ENTRIES)
+    pred = reachable_from(idx, roots)
+    # the hot set is a real interprocedural closure, not just the roots
+    assert len(pred) > 10 * len(roots)
