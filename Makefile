@@ -7,9 +7,10 @@ SHELL := /bin/bash    # tier1 uses pipefail/PIPESTATUS
 
 .PHONY: run run-agent run-scheduler demo test test-fast tier1 tier1-mesh \
         chaos chaos-lifecycle chaos-fleet chaos-overload chaos-kvtier \
-        chaos-trace chaos-signals chaos-elastic \
+        chaos-trace chaos-signals chaos-elastic chaos-tenant \
         diagnose-e2e bench bench-decode \
         bench-fleet bench-mesh bench-signals bench-elastic bench-prefill \
+        bench-tenant \
         dryrun smoke \
         preflight \
         deploy-agent docker \
@@ -116,6 +117,17 @@ chaos-elastic:
 	$(TEST_ENV) K8SLLM_LOCKCHECK=1 \
 	  $(PY) -m pytest tests/test_elasticity.py -q -p no:cacheprovider
 
+# Multi-tenant hardening acceptance (docs/resilience.md "Tenancy &
+# quotas"): identity normalization at the trust boundary, the
+# TenantGovernor reservation protocol (charged == delivered across
+# hedges, failovers, and a mid-stream replica kill), tenant-namespaced
+# KV isolation (cross-tenant lookups structurally miss, tenant_mismatch
+# installs refused), exporter top-K cardinality, and the flooding-tenant
+# burst with seeded lane_eviction faults — with lock discipline checked.
+chaos-tenant:
+	$(TEST_ENV) K8SLLM_LOCKCHECK=1 \
+	  $(PY) -m pytest tests/test_tenancy.py -q -p no:cacheprovider
+
 # Diagnosis acceptance (docs/diagnosis.md): grammar compiler units, the
 # constrained-sampling fuzz (every sample parses), and the synthetic
 # crash-loop burst → verdict e2e — with lock discipline checked.
@@ -166,6 +178,13 @@ bench-signals:
 bench-elastic:
 	$(TEST_ENV) BENCH_ELASTIC_ONLY=1 BENCH_MODEL=tiny BENCH_QUANT=none \
 	  $(PY) bench.py | tee elastic-bench.json
+
+# Multi-tenant fairness smoke: flooding tenant rate-limited with
+# tenant-tagged 429s while quiet Zipf tenants stay byte-exact within the
+# 2x-solo interactive TTFT budget, charged tokens == delivered tokens.
+bench-tenant:
+	$(TEST_ENV) BENCH_TENANT_ONLY=1 BENCH_MODEL=tiny BENCH_QUANT=none \
+	  $(PY) bench.py | tee tenant-bench.json
 
 smoke:              # boot server + 20-check live API suite
 	$(TEST_ENV) bash scripts/smoke.sh

@@ -47,6 +47,13 @@ import time
 
 CACHE_DIR = pathlib.Path(__file__).parent / ".jax_cache"
 
+# Single-tenant legs tag KV migrations with the default namespace
+# explicitly (the tenant-namespace lint requires the kwarg everywhere).
+# Pure-Python import: pulls no jax, so --help stays fast.
+from k8s_llm_monitor_tpu.resilience.tenancy import (  # noqa: E402
+    DEFAULT_TENANT as TEN,
+)
+
 
 def log(msg: str) -> None:
     print(msg, file=sys.stderr, flush=True)
@@ -198,6 +205,184 @@ def fleet_leg(cfg, params) -> dict:
         "fleet_affinity_hits": c2["affinity_hits"],
         "fleet_affinity_spills": c2["affinity_spills"],
         "fleet_concurrency": f_n,
+    }
+
+
+def tenant_fairness_leg(cfg, params) -> dict:
+    """Multi-tenant fairness (resilience/tenancy.py): a Zipf-weighted
+    population of quiet tenants with mixed SLO classes shares one engine
+    with a flooding tenant submitting 10x its request quota, under seeded
+    ``lane_eviction`` faults.  Gates (hard — a fairness regression IS a
+    bench failure):
+
+      * every flood refusal is a tenant-tagged 429 naming the flooder;
+      * no quiet tenant is ever quota-refused or shed;
+      * quiet interactive p99 TTFT stays <= 2x the solo (flood-free)
+        baseline of the identical burst;
+      * zero lost tokens: the governor's settled charge equals the tokens
+        each tenant's streams actually delivered;
+      * byte-exact: every quiet stream reproduces its solo-baseline
+        output despite the faults and the contention.
+    """
+    import numpy as np
+
+    from k8s_llm_monitor_tpu.resilience.errors import OverloadedError
+    from k8s_llm_monitor_tpu.resilience.faults import get_injector
+    from k8s_llm_monitor_tpu.resilience.tenancy import TenantGovernor
+    from k8s_llm_monitor_tpu.serving.engine import (
+        EngineConfig,
+        InferenceEngine,
+        SamplingParams,
+    )
+    from k8s_llm_monitor_tpu.serving.service import EngineService
+
+    rng = np.random.default_rng(17)
+    t_len = int(os.environ.get("BENCH_TENANT_PROMPT_LEN", "64"))
+    t_gen = int(os.environ.get("BENCH_TENANT_MAX_TOKENS", "16"))
+    t_n = int(os.environ.get("BENCH_TENANT_CONCURRENCY", "24"))
+    ttft_budget = float(os.environ.get("BENCH_TENANT_TTFT_BUDGET", "2.0"))
+    t_cap = t_len + t_gen + 16
+    t_ecfg = EngineConfig(
+        max_slots=8,
+        num_blocks=8 * ((t_cap + 15) // 16) + 16,
+        block_size=16,
+        max_blocks_per_seq=(t_cap + 15) // 16,
+        prefill_buckets=(t_len,),
+        max_prefills_per_step=8,
+        decode_steps_per_iter=4,
+    )
+
+    # Zipf-weighted quiet tenants (rank-r tenant gets ~1/r of the load)
+    # with SLO classes round-robined across the burst; prompts are fixed
+    # up front so the contended run must reproduce the solo bytes.
+    quiet = ("team-a", "team-b", "team-c", "team-d")
+    zipf = np.array([1.0 / (r + 1) for r in range(len(quiet))])
+    zipf /= zipf.sum()
+    classes = ("interactive", "standard", "batch")
+    plan = []
+    for i in range(t_n):
+        plan.append((
+            quiet[int(rng.choice(len(quiet), p=zipf))],
+            classes[i % len(classes)],
+            [int(t) for t in rng.integers(4, cfg.vocab_size - 4,
+                                          size=t_len)],
+        ))
+    per_tenant = {t: sum(1 for ten, _, _ in plan if ten == t)
+                  for t in quiet}
+    # Quota sized so every quiet tenant fits with headroom and the
+    # flooder's 10x burst mostly does not.
+    req_burst = float(max(per_tenant.values()) + 2)
+    flood_n = int(10 * req_burst)
+
+    def run_burst(svc, *, flood: bool):
+        flood_429 = 0
+        flood_handles = []
+        if flood:
+            for j in range(flood_n):
+                p = [int(t) for t in rng.integers(4, cfg.vocab_size - 4,
+                                                  size=t_len)]
+                try:
+                    flood_handles.append(svc.submit(
+                        p, SamplingParams(max_tokens=t_gen),
+                        request_id=f"flood-{j}", tenant="flood",
+                        slo_class="batch"))
+                except OverloadedError as exc:
+                    assert exc.tenant == "flood", \
+                        "flood refusal not tagged with the flooder"
+                    assert exc.retriable and exc.retry_after_s > 0
+                    flood_429 += 1
+        handles = [(ten, c, svc.submit(
+            list(p), SamplingParams(max_tokens=t_gen),
+            request_id=f"q{i}-{'c' if flood else 's'}", tenant=ten,
+            slo_class=c)) for i, (ten, c, p) in enumerate(plan)]
+        results = []
+        for ten, c, h in handles:
+            res = h.result(timeout=600.0)
+            assert res.finish_reason == "length", (ten, res.error)
+            assert len(res.token_ids) == t_gen, "lost tokens"
+            results.append((ten, c, res))
+        flood_delivered = 0
+        for h in flood_handles:
+            res = h.result(timeout=600.0)
+            if res.finish_reason == "length":
+                flood_delivered += len(res.token_ids)
+        return results, flood_429, len(flood_handles), flood_delivered
+
+    def p99_interactive(results):
+        ttfts = sorted(r.ttft_s for _, c, r in results
+                       if c == "interactive")
+        return float(np.percentile(np.array(ttfts), 99))
+
+    # Solo baseline: the identical quiet burst, no flood, no faults.
+    svc = EngineService(InferenceEngine(cfg, params, t_ecfg, eos_id=-1))
+    try:
+        base, _, _, _ = run_burst(svc, flood=False)
+    finally:
+        svc.stop(timeout=30)
+    solo_p99 = p99_interactive(base)
+    log(f"tenant: solo baseline interactive p99 TTFT "
+        f"{solo_p99 * 1e3:.1f} ms ({t_n} quiet reqs over {len(quiet)} "
+        f"Zipf tenants)")
+
+    gov = TenantGovernor(requests_per_s=0.5, request_burst=req_burst,
+                         tokens_per_s=float(t_gen),
+                         token_burst=req_burst * t_gen * 4.0)
+    svc = EngineService(InferenceEngine(cfg, params, t_ecfg, eos_id=-1),
+                        governor=gov)
+    get_injector().reset(seed=4321)
+    get_injector().arm("lane_eviction", rate=0.1, times=3)
+    try:
+        contended, flood_429, flood_ok, flood_delivered = run_burst(
+            svc, flood=True)
+    finally:
+        svc.stop(timeout=30)
+        get_injector().reset()
+
+    # The flooder was rate-limited (10x quota: most submissions refused)
+    # and within-quota tenants never felt it.
+    assert flood_429 > 0, "flood was never rate-limited"
+    snap = gov.snapshot()
+    assert snap["flood"]["quota_refusals"] == flood_429
+    for t in quiet:
+        assert snap[t]["quota_refusals"] == 0, f"{t} was quota-refused"
+        assert snap[t]["sheds"] == 0, f"{t} was shed by the flood"
+
+    # Byte-exact under faults + contention, and charged == delivered.
+    delivered = {t: 0 for t in quiet}
+    for (ten, _, solo_r), (ten2, _, cont_r) in zip(base, contended):
+        assert ten == ten2
+        assert cont_r.token_ids == solo_r.token_ids, \
+            f"{ten}: contended output diverged from solo baseline"
+        delivered[ten] += len(cont_r.token_ids)
+    deadline = time.monotonic() + 10.0
+    while (any(v["inflight"] for v in gov.snapshot().values())
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    for t in quiet:
+        assert gov.charged_tokens(t) == delivered[t], \
+            f"{t}: charged {gov.charged_tokens(t)} != delivered"
+    assert gov.charged_tokens("flood") == flood_delivered
+
+    cont_p99 = p99_interactive(contended)
+    ratio = cont_p99 / max(solo_p99, 1e-9)
+    log(f"tenant: contended interactive p99 TTFT {cont_p99 * 1e3:.1f} ms "
+        f"= {ratio:.2f}x solo ({flood_429}/{flood_n} flood reqs 429'd, "
+        f"{flood_ok} admitted, {get_injector().fired('lane_eviction')} "
+        f"lane_eviction faults fired)")
+    assert ratio <= ttft_budget, (
+        f"flood degraded quiet interactive p99 TTFT {ratio:.2f}x "
+        f"(budget {ttft_budget}x)")
+    return {
+        "tenant_interactive_p99_ttft_ratio": round(ratio, 3),
+        "tenant_solo_p99_ttft_ms": round(solo_p99 * 1e3, 2),
+        "tenant_contended_p99_ttft_ms": round(cont_p99 * 1e3, 2),
+        "tenant_flood_429s": flood_429,
+        "tenant_flood_submitted": flood_n,
+        "tenant_flood_admitted": flood_ok,
+        "tenant_quiet_requests": t_n,
+        "tenant_quiet_tenants": len(quiet),
+        "tenant_lost_tokens": 0,
+        "tenant_byte_exact": True,
     }
 
 
@@ -369,15 +554,15 @@ def migration_leg(cfg, params) -> dict:
         # blob is a prefix the target has NOT seen — installing an
         # already-cached prefix short-circuits before the scatter.
         owner.generate(warm2, sp).result(timeout=600.0)
-        wblob = owner.fetch_prefix(warm2)
-        assert wblob is not None and target.install_prefix(wblob) \
-            == "installed"
+        wblob = owner.fetch_prefix(warm2, tenant=TEN)
+        assert wblob is not None and target.install_prefix(
+            wblob, tenant=TEN) == "installed"
         owner.generate(p, sp).result(timeout=600.0)   # owner caches p
         reprefill_s = cold.generate(p, sp).result(timeout=600.0).ttft_s
         t0 = time.monotonic()
-        blob = owner.fetch_prefix(p)
+        blob = owner.fetch_prefix(p, tenant=TEN)
         assert blob is not None, "owner lost the prefix"
-        outcome = target.install_prefix(blob)
+        outcome = target.install_prefix(blob, tenant=TEN)
         assert outcome == "installed", outcome
         move_s = time.monotonic() - t0
         migration_s = move_s + target.generate(p, sp).result(
@@ -818,9 +1003,9 @@ def elasticity_leg(cfg, params) -> dict:
         first = owner.generate(p, SamplingParams(max_tokens=1)).result(
             timeout=600.0)
         cont = p + first.token_ids[:1]
-        blob = owner.fetch_prefix(cont)
+        blob = owner.fetch_prefix(cont, tenant=TEN)
         assert blob is not None, "owner exported no prefix"
-        outcome = target.install_prefix(blob)
+        outcome = target.install_prefix(blob, tenant=TEN)
         assert outcome in ("installed", "cached"), outcome
         handoff_ts.append(ttft_once(target, cont))
         cold_ts.append(ttft_once(cold, cont))
@@ -1381,6 +1566,20 @@ def main() -> None:
             "metric": "fleet_2replica_tok_s",
             "value": stats.get("fleet_2replica_tok_s", 0.0),
             "unit": "tok/s",
+            "extras": {"model": model_name, "platform": dev.platform,
+                       **stats},
+        }))
+        return
+
+    if os.environ.get("BENCH_TENANT_ONLY", "0") == "1":
+        # `make bench-tenant`: just the multi-tenant fairness leg — a
+        # flooding tenant rate-limited with tenant-tagged 429s while
+        # quiet Zipf tenants stay byte-exact within 2x their solo TTFT.
+        stats = tenant_fairness_leg(cfg, params)
+        print(json.dumps({
+            "metric": "tenant_interactive_p99_ttft_ratio",
+            "value": stats.get("tenant_interactive_p99_ttft_ratio", 0.0),
+            "unit": "x",
             "extras": {"model": model_name, "platform": dev.platform,
                        **stats},
         }))
@@ -2661,6 +2860,15 @@ def main() -> None:
     except Exception as exc:  # noqa: BLE001 — extras never fail the bench
         log(f"elasticity leg skipped: {exc}")
 
+    tenant_stats: dict = {}
+    try:
+        if os.environ.get("BENCH_TENANT", "1") == "1":
+            tenant_stats = tenant_fairness_leg(cfg, params)
+    except AssertionError:
+        raise  # a blown fairness/exactness gate IS a bench failure
+    except Exception as exc:  # noqa: BLE001 — extras never fail the bench
+        log(f"tenant fairness leg skipped: {exc}")
+
     extras = {
         "model": model_name,
         "quant": quant,
@@ -2787,6 +2995,7 @@ def main() -> None:
     extras.update(tracing_stats)
     extras.update(signals_stats)
     extras.update(elastic_stats)
+    extras.update(tenant_stats)
     log(f"total bench time {time.monotonic() - t0:.0f}s")
     print(json.dumps({
         "metric": "p50_ttft_100c_ms",

@@ -505,10 +505,100 @@ class UnconstrainedParseRule(Rule):
                     f"cannot reach callers")
 
 
+class TenantNamespaceRule(Rule):
+    """Prefix-KV key and blob paths must carry the tenant namespace.
+
+    The multi-tenant privacy invariant is structural: ``PrefixCache``
+    digests are seeded per tenant and ``KVX1`` blobs carry a tenant tag,
+    so a cross-tenant hit is impossible — *if* every call site passes the
+    tenant through.  A lookup/register/spill/migration call that omits it
+    silently lands in the default namespace, which either leaks one
+    tenant's prefix into another's accounting or (worse) bypasses the
+    per-tenant eviction cap.  This rule makes the omission a lint error
+    instead of a code-review hope.
+
+    Heuristics: ``lookup`` / ``register`` / ``digest_chain`` on a
+    receiver that looks like a prefix cache (leaf name ``pc`` or
+    containing ``prefix``/``cache``), ``put`` on a tier-like receiver,
+    and any ``export_prefix`` / ``fetch_prefix`` / ``install_prefix``
+    call must pass ``tenant=`` (``install_prefix`` accepts
+    ``expected_tenant=``).  A ``**kwargs`` splat counts as satisfied
+    (not analyzable).  The defining modules — ``serving/kv_cache.py``,
+    ``serving/kv_tier.py``, ``resilience/tenancy.py`` — are exempt.
+    """
+
+    name = "tenant-namespace"
+    description = "prefix-KV key/blob path without tenant namespacing"
+
+    _PC_METHODS = {"lookup", "register", "digest_chain"}
+    _TIER_METHODS = {"put"}
+    _BLOB_METHODS = {"export_prefix": ("tenant",),
+                     "fetch_prefix": ("tenant",),
+                     "install_prefix": ("tenant", "expected_tenant")}
+    _EXEMPT = ("serving/kv_cache.py", "serving/kv_tier.py",
+               "resilience/tenancy.py")
+
+    @staticmethod
+    def _leaf(expr: ast.AST) -> str:
+        return dotted_name(expr).rsplit(".", 1)[-1].lower()
+
+    @classmethod
+    def _is_pc_recv(cls, expr: ast.AST) -> bool:
+        leaf = cls._leaf(expr)
+        return leaf == "pc" or "prefix" in leaf or "cache" in leaf
+
+    @classmethod
+    def _is_tier_recv(cls, expr: ast.AST) -> bool:
+        return "tier" in cls._leaf(expr)
+
+    @staticmethod
+    def _has_kw(call: ast.Call, accepted: tuple[str, ...]) -> bool:
+        for kw in call.keywords:
+            if kw.arg is None:  # **kwargs splat: assume it's in there
+                return True
+            if kw.arg in accepted:
+                return True
+        return False
+
+    def check(self, tree: ast.Module, path: str) -> Iterator[Finding]:
+        norm = path.replace("\\", "/")
+        if any(norm.endswith(e) for e in self._EXEMPT):
+            return  # the namespacing implementations themselves
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            if attr in self._BLOB_METHODS:
+                accepted = self._BLOB_METHODS[attr]
+                if not self._has_kw(node, accepted):
+                    yield self.finding(
+                        path, node,
+                        f"'{attr}()' without {' / '.join(accepted)}= moves "
+                        f"a KV blob outside the tenant namespace; pass the "
+                        f"request's tenant through")
+            elif attr in self._PC_METHODS \
+                    and self._is_pc_recv(node.func.value):
+                if not self._has_kw(node, ("tenant",)):
+                    yield self.finding(
+                        path, node,
+                        f"prefix-cache '{attr}()' without tenant= lands in "
+                        f"the default namespace — cross-tenant prefix "
+                        f"leak; pass tenant= through from the request")
+            elif attr in self._TIER_METHODS \
+                    and self._is_tier_recv(node.func.value):
+                if not self._has_kw(node, ("tenant",)):
+                    yield self.finding(
+                        path, node,
+                        "host-tier 'put()' without tenant= skips per-"
+                        "tenant byte accounting and the max-share cap; "
+                        "tag the spill with the owning tenant")
+
+
 def default_rules() -> list[Rule]:
     return [JitHostReadRule(), LockBlockingCallRule(), BareExceptRule(),
             MutableDefaultRule(), FaultPointRule(), RawLockRule(),
-            UnconstrainedParseRule()]
+            UnconstrainedParseRule(), TenantNamespaceRule()]
 
 
 ALL_RULE_NAMES = tuple(r.name for r in default_rules())

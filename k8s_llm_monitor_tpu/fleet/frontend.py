@@ -46,27 +46,31 @@ class FleetAnalysis:
             error_kind=str(payload.get("error_kind", "")),
         )
 
-    def query(self, question: str,
-              slo_class: str = "interactive") -> AnalysisResponse:
+    def query(self, question: str, slo_class: str = "interactive",
+              tenant: str = "") -> AnalysisResponse:
         # Root (or joined) span for the text path: the replica's HTTP hop
         # inherits this context via the ApiClient traceparent header.
         with get_tracer().span("router.query", attrs={"class": slo_class}):
             return self._to_response(
-                self.router.query(question, slo_class=slo_class))
+                self.router.query(question, slo_class=slo_class,
+                                  tenant=tenant))
 
-    def query_stream(self, question: str, slo_class: str = "interactive"):
+    def query_stream(self, question: str, slo_class: str = "interactive",
+                     tenant: str = ""):
         # The span covers dispatch (replica choice + SSE open); streaming
         # itself is consumed by the HTTP handler after this returns.
         with get_tracer().span("router.query_stream",
                                attrs={"class": slo_class}):
-            return self.router.query_stream(question, slo_class=slo_class)
+            return self.router.query_stream(question, slo_class=slo_class,
+                                            tenant=tenant)
 
-    def analyze(self, request: AnalysisRequest) -> AnalysisResponse:
+    def analyze(self, request: AnalysisRequest,
+                tenant: str = "") -> AnalysisResponse:
         return self._to_response(self.router.analyze({
             "type": request.type,
             "parameters": request.parameters,
             "context": request.context,
-        }))
+        }, tenant=tenant))
 
     def diagnoses(self, limit: int = 0) -> dict:
         """Raw replica payload for GET /api/v1/diagnoses — the handler
@@ -102,6 +106,22 @@ def build_router_server(config, web_dir=None):
             f"replica-{i}", url,
             connect_timeout_s=fcfg.connect_timeout_s,
             read_timeout_s=fcfg.read_timeout_s))
+    governor = None
+    tcfg = getattr(config, "tenancy", None)
+    if tcfg is not None and tcfg.enabled:
+        from k8s_llm_monitor_tpu.resilience.tenancy import TenantGovernor
+
+        # Fleet tenancy: the router owns the ONE governor for the whole
+        # fleet — it admits per logical request before any replica
+        # dispatch, so hedges and failover replays can never double-charge
+        # (replicas behind this router run with governor=None).
+        governor = TenantGovernor(
+            requests_per_s=tcfg.requests_per_s,
+            request_burst=tcfg.request_burst,
+            tokens_per_s=tcfg.tokens_per_s,
+            token_burst=tcfg.token_burst,
+            enforce=tcfg.enforce,
+            max_tenants=tcfg.max_tenants)
     router = FleetRouter(
         registry, policy=fcfg.policy,
         hedge=HedgeConfig(enabled=fcfg.hedge_enabled,
@@ -110,7 +130,8 @@ def build_router_server(config, web_dir=None):
         max_failovers=fcfg.max_failovers,
         affinity_prefix_tokens=fcfg.affinity_prefix_tokens,
         batch_spill_threshold=fcfg.batch_spill_threshold,
-        drain_sweep_budget=fcfg.drain_sweep_budget)
+        drain_sweep_budget=fcfg.drain_sweep_budget,
+        governor=governor)
     registry.refresh()
     registry.start_probes(interval_s=fcfg.probe_interval_s)
     logger.info("router fronting %d replica(s), policy=%s, hedging=%s",
@@ -135,6 +156,7 @@ def build_router_server(config, web_dir=None):
     srv = MonitorServer(
         config=config, analysis=FleetAnalysis(router), web_dir=web_dir,
         signals=signals)
+    srv.governor = governor
     if signals is not None:
         signals.attach(srv)
     if config.autoscale.enabled and signals is not None:

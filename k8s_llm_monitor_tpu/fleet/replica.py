@@ -23,6 +23,7 @@ from __future__ import annotations
 import logging
 
 from k8s_llm_monitor_tpu.fleet.registry import ReplicaStats
+from k8s_llm_monitor_tpu.resilience.tenancy import DEFAULT_TENANT
 
 logger = logging.getLogger("fleet.replica")
 
@@ -52,20 +53,26 @@ class Replica:
 
     def generate(self, prompt_ids: list[int], sampling=None,
                  request_id: str | None = None, deadline_s: float = 0.0,
-                 slo_class: str = "standard"):
-        """Submit one generation; returns a ``RequestHandle``."""
+                 slo_class: str = "standard",
+                 tenant: str = DEFAULT_TENANT):
+        """Submit one generation; returns a ``RequestHandle``.  The quota
+        charge for ``tenant`` already happened at the router — the replica
+        only uses it for KV namespacing and journal accounting."""
         raise NotImplementedError(f"{self.replica_id}: token interface")
 
     # -- text-level query API (HTTP replicas) ---------------------------
 
-    def query(self, question: str, slo_class: str = "interactive") -> dict:
+    def query(self, question: str, slo_class: str = "interactive",
+              tenant: str = DEFAULT_TENANT) -> dict:
         raise NotImplementedError(f"{self.replica_id}: query interface")
 
-    def query_stream(self, question: str, slo_class: str = "interactive"):
+    def query_stream(self, question: str, slo_class: str = "interactive",
+                     tenant: str = DEFAULT_TENANT):
         """Returns (request_id, model, iterator of text deltas)."""
         raise NotImplementedError(f"{self.replica_id}: query interface")
 
-    def analyze(self, payload: dict) -> dict:
+    def analyze(self, payload: dict,
+                tenant: str = DEFAULT_TENANT) -> dict:
         raise NotImplementedError(f"{self.replica_id}: query interface")
 
     def diagnoses(self, limit: int = 0) -> dict:
@@ -74,17 +81,21 @@ class Replica:
 
     # -- KV prefix migration (serving/kv_tier.py blob framing) ----------
 
-    def fetch_prefix(self, token_ids: list[int]):
+    def fetch_prefix(self, token_ids: list[int],
+                     tenant: str = DEFAULT_TENANT):
         """Framed KV pages for the longest cached prefix of ``token_ids``
-        (``bytes``), or None on a cache miss.  The router's migration path
-        calls this on the prefix-affinity *owner* when dispatch landed
-        elsewhere."""
+        under ``tenant``'s namespace (``bytes``), or None on a cache miss.
+        The router's migration path calls this on the prefix-affinity
+        *owner* when dispatch landed elsewhere."""
         raise NotImplementedError(f"{self.replica_id}: kv migration")
 
-    def install_prefix(self, blob: bytes) -> str:
+    def install_prefix(self, blob: bytes,
+                       tenant: str | None = None) -> str:
         """Install a fetched prefix blob into this replica's KV pool.
-        Returns the engine's outcome string: ``installed`` / ``cached`` /
-        ``incompatible`` / ``nospace``."""
+        With ``tenant`` set, a blob whose header names a different tenant
+        is refused (``tenant_mismatch``).  Returns the engine's outcome
+        string: ``installed`` / ``cached`` / ``incompatible`` /
+        ``nospace`` / ``tenant_mismatch``."""
         raise NotImplementedError(f"{self.replica_id}: kv migration")
 
     # -- tracing ---------------------------------------------------------
@@ -177,17 +188,19 @@ class LocalReplica(Replica):
 
     def generate(self, prompt_ids: list[int], sampling=None,
                  request_id: str | None = None, deadline_s: float = 0.0,
-                 slo_class: str = "standard"):
+                 slo_class: str = "standard",
+                 tenant: str = DEFAULT_TENANT):
         if self._killed:
             raise ReplicaUnavailable(f"{self.replica_id}: killed")
         try:
             if self.supervisor is not None:
                 return self.supervisor.submit(
                     prompt_ids, sampling, request_id=request_id,
-                    deadline_s=deadline_s, slo_class=slo_class)
+                    deadline_s=deadline_s, slo_class=slo_class,
+                    tenant=tenant)
             return self.service.submit(
                 prompt_ids, sampling, request_id=request_id,
-                deadline_s=deadline_s, slo_class=slo_class)
+                deadline_s=deadline_s, slo_class=slo_class, tenant=tenant)
         except RuntimeError as exc:
             # Dead service: a routing fact, not a caller error.
             raise ReplicaUnavailable(str(exc)) from exc
@@ -207,12 +220,15 @@ class LocalReplica(Replica):
         except (RuntimeError, TimeoutError) as exc:
             raise ReplicaUnavailable(str(exc)) from exc
 
-    def fetch_prefix(self, token_ids: list[int]):
+    def fetch_prefix(self, token_ids: list[int],
+                     tenant: str = DEFAULT_TENANT):
         ids = list(token_ids)
-        return self._call(lambda e: e.export_prefix(ids))
+        return self._call(lambda e: e.export_prefix(ids, tenant=tenant))
 
-    def install_prefix(self, blob: bytes) -> str:
-        return self._call(lambda e: e.install_prefix(blob))
+    def install_prefix(self, blob: bytes,
+                       tenant: str | None = None) -> str:
+        return self._call(
+            lambda e: e.install_prefix(blob, expected_tenant=tenant))
 
     def fetch_trace(self, trace_id: str) -> list[dict]:
         # In-process replicas share the process tracer: the router's
@@ -264,27 +280,32 @@ class HTTPReplica(Replica):
     def stats(self) -> ReplicaStats:
         return ReplicaStats.from_payload(self.client.stats())
 
-    def query(self, question: str, slo_class: str = "interactive") -> dict:
+    def query(self, question: str, slo_class: str = "interactive",
+              tenant: str = DEFAULT_TENANT) -> dict:
         from k8s_llm_monitor_tpu.monitor.client import ApiConnectionError
 
         try:
-            return self.client.query(question, slo_class=slo_class)
+            return self.client.query(question, slo_class=slo_class,
+                                     tenant=tenant)
         except ApiConnectionError as exc:
             raise ReplicaUnavailable(str(exc)) from exc
 
-    def query_stream(self, question: str, slo_class: str = "interactive"):
+    def query_stream(self, question: str, slo_class: str = "interactive",
+                     tenant: str = DEFAULT_TENANT):
         from k8s_llm_monitor_tpu.monitor.client import ApiConnectionError
 
         try:
-            return self.client.query_stream(question, slo_class=slo_class)
+            return self.client.query_stream(question, slo_class=slo_class,
+                                            tenant=tenant)
         except ApiConnectionError as exc:
             raise ReplicaUnavailable(str(exc)) from exc
 
-    def analyze(self, payload: dict) -> dict:
+    def analyze(self, payload: dict,
+                tenant: str = DEFAULT_TENANT) -> dict:
         from k8s_llm_monitor_tpu.monitor.client import ApiConnectionError
 
         try:
-            return self.client.analyze(payload)
+            return self.client.analyze(payload, tenant=tenant)
         except ApiConnectionError as exc:
             raise ReplicaUnavailable(str(exc)) from exc
 
@@ -296,19 +317,21 @@ class HTTPReplica(Replica):
         except ApiConnectionError as exc:
             raise ReplicaUnavailable(str(exc)) from exc
 
-    def fetch_prefix(self, token_ids: list[int]):
+    def fetch_prefix(self, token_ids: list[int],
+                     tenant: str = DEFAULT_TENANT):
         from k8s_llm_monitor_tpu.monitor.client import ApiConnectionError
 
         try:
-            return self.client.kv_prefix(token_ids)
+            return self.client.kv_prefix(token_ids, tenant=tenant)
         except ApiConnectionError as exc:
             raise ReplicaUnavailable(str(exc)) from exc
 
-    def install_prefix(self, blob: bytes) -> str:
+    def install_prefix(self, blob: bytes,
+                       tenant: str | None = None) -> str:
         from k8s_llm_monitor_tpu.monitor.client import ApiConnectionError
 
         try:
-            return self.client.kv_install(blob)
+            return self.client.kv_install(blob, tenant=tenant)
         except ApiConnectionError as exc:
             raise ReplicaUnavailable(str(exc)) from exc
 

@@ -61,6 +61,11 @@ from k8s_llm_monitor_tpu.fleet.replica import ReplicaUnavailable
 from k8s_llm_monitor_tpu.observability.tracing import Tracer, get_tracer
 from k8s_llm_monitor_tpu.resilience.errors import OverloadedError
 from k8s_llm_monitor_tpu.resilience.retry import CircuitOpen
+from k8s_llm_monitor_tpu.resilience.tenancy import (
+    DEFAULT_TENANT,
+    TenantGovernor,
+    normalize_tenant,
+)
 from k8s_llm_monitor_tpu.serving.engine import GenerationResult, SamplingParams
 from k8s_llm_monitor_tpu.serving.kv_tier import BlobError
 from k8s_llm_monitor_tpu.serving.service import RequestHandle
@@ -202,6 +207,7 @@ class _Flight:
     deadline_s: float
     digest: bytes
     slo_class: str
+    tenant: str
     handle: RequestHandle               # fleet-level, what the caller holds
     inner: Optional[RequestHandle]      # current replica-level handle
     replica_id: str
@@ -245,13 +251,20 @@ class FleetRouter:
                  stall_timeout_s: float = 120.0,
                  batch_spill_threshold: float = 0.75,
                  migrate_prefixes: bool = True,
-                 drain_sweep_budget: int = 8):
+                 drain_sweep_budget: int = 8,
+                 governor: TenantGovernor | None = None):
         if policy not in POLICIES:
             raise ValueError(
                 f"unknown policy {policy!r} (have {sorted(POLICIES)})")
         self.registry = registry
         self.policy = POLICIES[policy]()
         self.hedge = hedge or HedgeConfig()
+        # Router-owned tenant governor: quota is charged ONCE per logical
+        # request here — replica-level dispatches (hedge legs, failover
+        # replays, decode handoffs) are fan-out of the same reservation
+        # and must never re-charge it, so replicas behind a router run
+        # without a governor of their own.
+        self.governor = governor
         self.max_failovers = max_failovers
         self.affinity_prefix_tokens = affinity_prefix_tokens
         self.stall_timeout_s = stall_timeout_s
@@ -284,10 +297,10 @@ class FleetRouter:
         # dispatch_failed) count WHY a handoff degraded.
         self._handoffs: dict[str, int] = {}
         # Recently-dispatched prefix heads: digest -> (head tokens, last
-        # replica).  The drain sweep reads this to proactively offer a
-        # draining replica's cached prefixes to their new rendezvous
-        # owners; bounded LRU so it never grows with traffic.
-        self._recent_prefixes: dict[bytes, tuple[list[int], str]] = {}
+        # replica, tenant).  The drain sweep reads this to proactively
+        # offer a draining replica's cached prefixes to their new
+        # rendezvous owners; bounded LRU so it never grows with traffic.
+        self._recent_prefixes: dict[bytes, tuple[list[int], str, str]] = {}
         self._recent_prefixes_cap = 128
         self.drain_sweep_budget = drain_sweep_budget
         self.drain_sweeps = 0
@@ -347,10 +360,15 @@ class FleetRouter:
                 out.append((rid, entry.replica))
         return out
 
-    def _token_digest(self, prompt_ids: list[int]) -> bytes:
+    def _token_digest(self, prompt_ids: list[int],
+                      tenant: str = DEFAULT_TENANT) -> bytes:
+        # Tenant folded in so affinity routing mirrors the tenant-seeded
+        # prefix-cache key space: two tenants sharing a prompt have
+        # *different* cached prefixes, so they are different affinity keys.
         head = prompt_ids[: self.affinity_prefix_tokens]
         return hashlib.sha256(
-            b",".join(str(t).encode() for t in head)).digest()
+            tenant.encode() + b"\x00"
+            + b",".join(str(t).encode() for t in head)).digest()
 
     @staticmethod
     def _text_digest(question: str) -> bytes:
@@ -418,7 +436,8 @@ class FleetRouter:
             self._handoffs[outcome] = self._handoffs.get(outcome, 0) + 1
 
     def _maybe_migrate_prefix(self, digest: bytes, prompt_ids: list[int],
-                              ranked: list[Candidate]) -> None:
+                              ranked: list[Candidate],
+                              tenant: str = DEFAULT_TENANT) -> None:
         """When dispatch is about to land off the affinity owner, pull the
         owner's cached KV pages for this prompt and install them on the
         actual target first — the target's prefill then hits its prefix
@@ -446,7 +465,7 @@ class FleetRouter:
                        "outcome": outcome})
 
         try:
-            blob = owner.replica.fetch_prefix(prompt_ids)
+            blob = owner.replica.fetch_prefix(prompt_ids, tenant=tenant)
         except ReplicaUnavailable:
             self._bump_migration("owner_down")
             _span("owner_down", status="error")
@@ -461,7 +480,7 @@ class FleetRouter:
             _span("miss")
             return
         try:
-            outcome = target.replica.install_prefix(blob)
+            outcome = target.replica.install_prefix(blob, tenant=tenant)
         except Exception:  # noqa: BLE001 — migration is best-effort
             logger.exception("prefix install on %s failed",
                              target.replica_id)
@@ -477,11 +496,12 @@ class FleetRouter:
     # -- membership lifecycle: drain sweep + removal GC ------------------
 
     def _note_prefix(self, digest: bytes, prompt_ids: list[int],
-                     replica_id: str) -> None:
+                     replica_id: str,
+                     tenant: str = DEFAULT_TENANT) -> None:
         head = list(prompt_ids[: self.affinity_prefix_tokens])
         with self._lock:
             self._recent_prefixes.pop(digest, None)
-            self._recent_prefixes[digest] = (head, replica_id)
+            self._recent_prefixes[digest] = (head, replica_id, tenant)
             while len(self._recent_prefixes) > self._recent_prefixes_cap:
                 self._recent_prefixes.pop(
                     next(iter(self._recent_prefixes)))
@@ -491,7 +511,7 @@ class FleetRouter:
         replica that left the fleet (wired to ``registry.subscribe_remove``
         — the registry already dropped its breaker/inflight state)."""
         with self._lock:
-            for dig in [d for d, (_, owner)
+            for dig in [d for d, (_, owner, _t)
                         in self._recent_prefixes.items()
                         if owner == replica_id]:
                 del self._recent_prefixes[dig]
@@ -509,7 +529,7 @@ class FleetRouter:
                                False)):
             return
         with self._lock:
-            owned = [(dig, head) for dig, (head, owner)
+            owned = [(dig, head, ten) for dig, (head, owner, ten)
                      in self._recent_prefixes.items()
                      if owner == replica_id]
         cands = [c for c in self.registry.candidates()
@@ -518,7 +538,7 @@ class FleetRouter:
         if not cands or not owned:
             return
         moved = 0
-        for dig, head in owned:
+        for dig, head, ten in owned:
             if moved >= self.drain_sweep_budget:
                 break
             pref = self.policy.preferred(cands, dig)
@@ -529,7 +549,7 @@ class FleetRouter:
             if target is None:
                 break
             try:
-                blob = entry.replica.fetch_prefix(head)
+                blob = entry.replica.fetch_prefix(head, tenant=ten)
             except ReplicaUnavailable:
                 self._bump_migration("owner_down")
                 break  # owner died mid-drain: nothing more to offer
@@ -542,7 +562,8 @@ class FleetRouter:
                 self._bump_migration("miss")
                 continue
             try:
-                outcome = str(target.replica.install_prefix(blob))
+                outcome = str(target.replica.install_prefix(blob,
+                                                            tenant=ten))
             except Exception:  # noqa: BLE001 — sweep is best-effort
                 logger.exception("drain sweep install on %s failed",
                                  target.replica_id)
@@ -552,7 +573,8 @@ class FleetRouter:
             if outcome in ("installed", "cached"):
                 moved += 1
                 with self._lock:
-                    self._recent_prefixes[dig] = (head, target.replica_id)
+                    self._recent_prefixes[dig] = (head, target.replica_id,
+                                                  ten)
         if moved:
             self._bump("drain_sweeps", moved)
             logger.info("drain sweep moved %d prefixes off %s",
@@ -564,7 +586,8 @@ class FleetRouter:
                          prompt_ids: list[int], sampling: SamplingParams,
                          request_id: str, deadline_s: float,
                          exclude: frozenset[str] | set[str] = frozenset(),
-                         slo_class: str = "standard"):
+                         slo_class: str = "standard",
+                         tenant: str = DEFAULT_TENANT):
         """Try candidates in rank order; returns (replica_id, handle) or
         (None, last_error).  Breaker gates each attempt."""
         last_exc: Exception | None = None
@@ -582,7 +605,8 @@ class FleetRouter:
             try:
                 handle = cand.replica.generate(
                     prompt_ids, sampling, request_id=request_id,
-                    deadline_s=deadline_s, slo_class=slo_class)
+                    deadline_s=deadline_s, slo_class=slo_class,
+                    tenant=tenant)
             except OverloadedError as exc:
                 entry.breaker.record_success()  # alive, just shedding
                 last_exc = exc
@@ -601,12 +625,22 @@ class FleetRouter:
                sampling: SamplingParams | None = None,
                request_id: str | None = None,
                deadline_s: float = 0.0,
-               slo_class: str = "standard") -> RequestHandle:
+               slo_class: str = "standard",
+               tenant: str = DEFAULT_TENANT) -> RequestHandle:
         """Admit one generation into the fleet.  Raises ``OverloadedError``
         when no replica will take it (counted as a shed); otherwise returns
-        a handle whose stream survives replica death transparently."""
+        a handle whose stream survives replica death transparently.
+        Tenant quota is charged here, once — every downstream replica
+        dispatch (hedge, failover, handoff) rides the same reservation."""
         sampling = sampling or SamplingParams()
+        tenant = normalize_tenant(tenant)
         rid = request_id or f"fleet-{next(self._ids)}"
+        if self.governor is not None:
+            # Raises a tenant-tagged OverloadedError (HTTP 429) before any
+            # replica sees the request; reserves max_tokens until settle.
+            self.governor.admit(
+                tenant, rid, max_tokens=sampling.max_tokens,
+                prompt_bytes=len(prompt_ids) * 4, slo_class=slo_class)
         tracer = get_tracer()
         # A fresh child of the caller's context (set by the HTTP server
         # from traceparent), or a new root when the router is where this
@@ -615,7 +649,7 @@ class FleetRouter:
         trace = Tracer.child(parent) if parent is not None \
             else tracer.new_trace()
         tracer.bind(rid, trace)
-        digest = self._token_digest(prompt_ids)
+        digest = self._token_digest(prompt_ids, tenant)
         t_rank = time.monotonic()
         ranked = self._ranked(digest, need_tokens=True, slo_class=slo_class)
         # Disaggregated dispatch: with both roles present, the request
@@ -633,16 +667,24 @@ class FleetRouter:
                 chosen, handle = self._dispatch_tokens(
                     prefill_ranked, prompt_ids,
                     dataclasses.replace(sampling, max_tokens=1),
-                    f"{rid}-a0", deadline_s, slo_class=slo_class)
+                    f"{rid}-a0", deadline_s, slo_class=slo_class,
+                    tenant=tenant)
                 if chosen is None:
                     disagg = False  # no prefill taker: degrade to unified
             if not disagg and ranked and chosen is None:
-                self._maybe_migrate_prefix(digest, prompt_ids, ranked)
+                self._maybe_migrate_prefix(digest, prompt_ids, ranked,
+                                           tenant)
                 chosen, handle = self._dispatch_tokens(
                     ranked, prompt_ids, sampling, f"{rid}-a0", deadline_s,
-                    slo_class=slo_class)
+                    slo_class=slo_class, tenant=tenant)
         if chosen is None:
             self._bump("sheds")
+            if self.governor is not None:
+                # Nothing was generated: release the token reservation.
+                # The request-rate charge stands — a shed storm still
+                # counts against the tenant's rate.
+                self.governor.settle(rid)
+                self.governor.note_shed(tenant)
             self._end_flight_span_at(trace, rid, t_rank, "error",
                                      outcome="shed")
             err = handle  # last error from dispatch, or None when empty
@@ -651,9 +693,9 @@ class FleetRouter:
             raise OverloadedError(
                 f"no replica available ({err or 'fleet empty'})",
                 retriable=True, retry_after_s=1.0, slo_class=slo_class,
-                request_id=rid)
+                request_id=rid, tenant=tenant)
         self._account_affinity(digest, chosen, ranked)
-        self._note_prefix(digest, prompt_ids, chosen)
+        self._note_prefix(digest, prompt_ids, chosen, tenant)
         tracer.record("router.dispatch", t_rank, time.monotonic(), trace,
                       attrs={"request_id": rid, "replica": chosen,
                              "attempt": 0, "class": slo_class,
@@ -662,6 +704,7 @@ class FleetRouter:
         flight = _Flight(
             rid=rid, prompt_ids=list(prompt_ids), sampling=sampling,
             deadline_s=deadline_s, digest=digest, slo_class=slo_class,
+            tenant=tenant,
             handle=RequestHandle(rid, eos_id=None), inner=handle,
             replica_id=chosen, dispatch_t0=time.monotonic(), trace=trace,
             submit_t0=t_rank, pending_decode=disagg)
@@ -693,6 +736,18 @@ class FleetRouter:
         inner = fl.inner
         if inner is not None:
             inner.cancel()
+
+    def _settle_flight(self, fl: _Flight) -> None:
+        """Finalize the tenant reservation on a terminal outcome: exactly
+        the tokens streamed to the caller stay charged (``fl.emitted`` is
+        appended once per delivered token, across every replica
+        incarnation), the rest of the reservation is refunded.  Hedge
+        losers and failover replays never touched the governor, so there
+        is nothing to reconcile beyond this one settlement."""
+        if self.governor is None:
+            return
+        self.governor.note_delivered(fl.rid, len(fl.emitted))
+        self.governor.settle(fl.rid)
 
     # -- pump: stream, hedge, fail over ---------------------------------
 
@@ -740,7 +795,8 @@ class FleetRouter:
                     chosen, handle = self._dispatch_tokens(
                         ranked, fl.prompt_ids + fl.emitted, replay,
                         f"{fl.rid}-a{fl.attempts}", fl.deadline_s,
-                        exclude={fl.replica_id}, slo_class=fl.slo_class)
+                        exclude={fl.replica_id}, slo_class=fl.slo_class,
+                        tenant=fl.tenant)
                     if chosen is None:
                         return self._fail(
                             fl, f"no healthy replica for failover ({handle})")
@@ -804,6 +860,7 @@ class FleetRouter:
                     self.registry.note_done(fl.replica_id, ok=True)
                     return _HANDOFF
                 fl.handle._replay_prefix = list(fl.prior)
+                self._settle_flight(fl)
                 fl.handle._push([], res)
                 self.registry.note_done(
                     fl.replica_id, ok=res.finish_reason != "error")
@@ -862,7 +919,7 @@ class FleetRouter:
             target = decode_ranked[0]
             blob = None
             try:
-                blob = owner.fetch_prefix(prompt)
+                blob = owner.fetch_prefix(prompt, tenant=fl.tenant)
             except ReplicaUnavailable:
                 cause = "owner_down"
             except Exception:  # noqa: BLE001 — handoff is best-effort
@@ -872,7 +929,8 @@ class FleetRouter:
                 cause = "miss"
             if cause is None:
                 try:
-                    outcome = str(target.replica.install_prefix(blob))
+                    outcome = str(target.replica.install_prefix(
+                        blob, tenant=fl.tenant))
                 except BlobError:
                     cause = "torn"
                 except ReplicaUnavailable:
@@ -889,7 +947,8 @@ class FleetRouter:
             if cause is None:
                 chosen, handle = self._dispatch_tokens(
                     [target], prompt, cont, f"{fl.rid}-d{fl.attempts}",
-                    fl.deadline_s, slo_class=fl.slo_class)
+                    fl.deadline_s, slo_class=fl.slo_class,
+                    tenant=fl.tenant)
                 if chosen is None:
                     cause = "dispatch_failed"
 
@@ -908,14 +967,15 @@ class FleetRouter:
             if local is not None:
                 chosen, handle = self._dispatch_tokens(
                     [local], prompt, cont, f"{fl.rid}-l{fl.attempts}",
-                    fl.deadline_s, slo_class=fl.slo_class)
+                    fl.deadline_s, slo_class=fl.slo_class,
+                    tenant=fl.tenant)
             landing = "local"
         if chosen is None:
             # Rung 3: P is gone too — plain failover replay elsewhere.
             chosen, handle = self._dispatch_tokens(
                 ranked, prompt, cont, f"{fl.rid}-f{fl.attempts}",
                 fl.deadline_s, exclude={prefill_id},
-                slo_class=fl.slo_class)
+                slo_class=fl.slo_class, tenant=fl.tenant)
             landing = "replay"
         if chosen is None:
             return (f"handoff failed ({cause or 'no target'}) and no "
@@ -963,7 +1023,8 @@ class FleetRouter:
                               slo_class=fl.slo_class)
         chosen, hedge_handle = self._dispatch_tokens(
             ranked, fl.prompt_ids, fl.sampling, f"{fl.rid}-h",
-            fl.deadline_s, exclude={fl.replica_id}, slo_class=fl.slo_class)
+            fl.deadline_s, exclude={fl.replica_id}, slo_class=fl.slo_class,
+            tenant=fl.tenant)
         if chosen is None:
             return None
         self._bump("hedges_fired")
@@ -1009,6 +1070,7 @@ class FleetRouter:
 
     def _fail(self, fl: _Flight, msg: str) -> None:
         self._bump("failed")
+        self._settle_flight(fl)
         self._end_flight_span(fl, "error", error=msg[:200])
         fl.handle._replay_prefix = []
         fl.handle._push([], GenerationResult(
@@ -1018,6 +1080,7 @@ class FleetRouter:
     def _finish_trimmed(self, fl: _Flight) -> None:
         """The dying replica had already emitted the full budget: complete
         with what was streamed (nothing left to regenerate)."""
+        self._settle_flight(fl)
         self._end_flight_span(fl, "ok", finish_reason="length")
         fl.handle._replay_prefix = []
         fl.handle._push([], GenerationResult(
@@ -1066,19 +1129,37 @@ class FleetRouter:
             f"no replica available ({last_exc or 'fleet empty'})",
             retriable=True, retry_after_s=1.0, slo_class=slo_class)
 
+    def _admit_text(self, tenant: str, slo_class: str) -> None:
+        """Rate-only quota for the text paths: there is no token budget to
+        reserve up front (the replica owns generation), so charge one
+        request-bucket token and settle the empty reservation at once.
+        Raises the tenant-tagged 429 before any replica is contacted."""
+        if self.governor is None:
+            return
+        rid = f"fleet-q-{next(self._ids)}"
+        self.governor.admit(tenant, rid, max_tokens=0, slo_class=slo_class)
+        self.governor.settle(rid)
+
     def query(self, question: str,
-              slo_class: str = "interactive") -> dict:
+              slo_class: str = "interactive",
+              tenant: str = DEFAULT_TENANT) -> dict:
+        tenant = normalize_tenant(tenant)
+        self._admit_text(tenant, slo_class)
         rid, payload = self._dispatch_text(
             self._text_digest(question),
-            lambda r: r.query(question, slo_class=slo_class),
+            lambda r: r.query(question, slo_class=slo_class,
+                              tenant=tenant),
             slo_class=slo_class)
         self.registry.note_done(rid, ok=True)
         return payload
 
-    def analyze(self, payload: dict) -> dict:
+    def analyze(self, payload: dict,
+                tenant: str = DEFAULT_TENANT) -> dict:
+        tenant = normalize_tenant(tenant)
+        self._admit_text(tenant, "standard")
         rid, out = self._dispatch_text(
             self._text_digest(payload.get("type", "")),
-            lambda r: r.analyze(payload))
+            lambda r: r.analyze(payload, tenant=tenant))
         self.registry.note_done(rid, ok=True)
         return out
 
@@ -1094,15 +1175,21 @@ class FleetRouter:
             out["replica"] = rid
         return out
 
-    def query_stream(self, question: str, slo_class: str = "interactive"):
+    def query_stream(self, question: str, slo_class: str = "interactive",
+                     tenant: str = DEFAULT_TENANT):
         """Returns (request_id, model, delta iterator).  The iterator fails
         over mid-stream: a new replica re-answers and the already-delivered
         character prefix is suppressed, so the caller sees a contiguous
         stream (exact for deterministic backends — greedy decode over the
-        same evidence; the token-level path is the strict contract)."""
+        same evidence; the token-level path is the strict contract).
+        Failover re-dispatches ride the original admission — the quota
+        charge happens once, here."""
+        tenant = normalize_tenant(tenant)
+        self._admit_text(tenant, slo_class)
         digest = self._text_digest(question)
         rid, (rep_rid, model, chunks) = self._dispatch_text(
-            digest, lambda r: r.query_stream(question, slo_class=slo_class),
+            digest, lambda r: r.query_stream(question, slo_class=slo_class,
+                                             tenant=tenant),
             slo_class=slo_class)
 
         def deltas():
@@ -1140,7 +1227,8 @@ class FleetRouter:
                         rid, (_, _, chunks) = self._dispatch_text(
                             digest,
                             lambda r: r.query_stream(question,
-                                                     slo_class=slo_class),
+                                                     slo_class=slo_class,
+                                                     tenant=tenant),
                             slo_class=slo_class)
                     except OverloadedError:
                         self._bump("failed")

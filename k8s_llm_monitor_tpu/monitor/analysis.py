@@ -69,25 +69,28 @@ class LLMBackend:
 
     def generate(
         self, prompt: str, max_tokens: int = 512, temperature: float = 0.1,
-        slo_class: str = "standard",
+        slo_class: str = "standard", tenant: str = "",
     ) -> str:
-        # ``slo_class`` is scheduling metadata for backends with an
-        # admission layer (LocalEngineBackend); remote/template backends
-        # accept and ignore it so callers can tag unconditionally.
+        # ``slo_class`` and ``tenant`` are scheduling/accounting metadata
+        # for backends with an admission layer (LocalEngineBackend);
+        # remote/template backends accept and ignore them so callers can
+        # tag unconditionally.  ``tenant=""`` means the default tenant.
         raise NotImplementedError
 
     def generate_stream(
         self, prompt: str, max_tokens: int = 512, temperature: float = 0.1,
-        slo_class: str = "standard",
+        slo_class: str = "standard", tenant: str = "",
     ):
         """Yield text chunks.  Backends without true streaming yield the
         whole completion once (keeps the SSE route backend-agnostic)."""
         yield self.generate(prompt, max_tokens=max_tokens,
-                            temperature=temperature, slo_class=slo_class)
+                            temperature=temperature, slo_class=slo_class,
+                            tenant=tenant)
 
     def generate_constrained(self, prompt: str,
                              temperature: float = 0.0,
-                             slo_class: str = "standard") -> str:
+                             slo_class: str = "standard",
+                             tenant: str = "") -> str:
         """Return Verdict JSON valid under ``diagnosis.grammar``'s schema.
 
         Default path for backends without token-level masking (remote
@@ -99,7 +102,7 @@ class LLMBackend:
         """
         text = self.generate(prompt, max_tokens=512,
                              temperature=temperature,
-                             slo_class=slo_class).strip()
+                             slo_class=slo_class, tenant=tenant).strip()
         try:
             parse_verdict(text)
             return text
@@ -130,7 +133,7 @@ class TemplateBackend(LLMBackend):
 
     def generate(
         self, prompt: str, max_tokens: int = 512, temperature: float = 0.1,
-        slo_class: str = "standard",
+        slo_class: str = "standard", tenant: str = "",
     ) -> str:
         issues = [
             line.strip("- ").strip()
@@ -151,7 +154,8 @@ class TemplateBackend(LLMBackend):
 
     def generate_constrained(self, prompt: str,
                              temperature: float = 0.0,
-                             slo_class: str = "standard") -> str:
+                             slo_class: str = "standard",
+                             tenant: str = "") -> str:
         """Deterministic grammar-valid verdict from the evidence sections —
         same extraction as ``generate``, rendered through the canonical
         serializer so it parses under the verdict grammar by construction."""
@@ -196,7 +200,8 @@ class LocalEngineBackend(LLMBackend):
 
     def __init__(self, engine=None, tokenizer=None, *,
                  dev_weights: bool = False, engine_factory=None,
-                 lifecycle: LifecycleConfig | None = None) -> None:
+                 lifecycle: LifecycleConfig | None = None,
+                 governor=None) -> None:
         """Two construction modes:
 
         * ``engine=`` (tests, ad-hoc wiring): the service wraps the given
@@ -212,6 +217,11 @@ class LocalEngineBackend(LLMBackend):
         self.tokenizer = tokenizer
         self.supervisor = None
         self._service = None
+        # resilience.tenancy.TenantGovernor (or None): per-tenant admission
+        # quotas on single-replica roles.  Owned here (above the supervisor)
+        # so reservations survive engine rebuilds; the HTTP layer reads it
+        # for /api/v1/stats and the tenant_* exporter families.
+        self.governor = governor
         if engine_factory is not None:
             from k8s_llm_monitor_tpu.resilience.journal import RequestJournal
             from k8s_llm_monitor_tpu.resilience.retry import Backoff
@@ -231,10 +241,11 @@ class LocalEngineBackend(LLMBackend):
                 heartbeat_timeout_s=lc.heartbeat_timeout_s,
                 backoff=Backoff(base_s=lc.restart_backoff_s,
                                 cap_s=max(lc.restart_backoff_s * 8, 5.0),
-                                jitter=0.0))
+                                jitter=0.0),
+                governor=governor)
         else:
             assert engine is not None, "engine or engine_factory required"
-            self._service = EngineService(engine)
+            self._service = EngineService(engine, governor=governor)
             if getattr(engine, "_grammar", None) is None:
                 self._install_verdict_grammar(engine, tokenizer)
         # Decode-rate EMAs (ms/token) for the exporter's
@@ -264,12 +275,14 @@ class LocalEngineBackend(LLMBackend):
     def engine(self):
         return self.service.engine
 
-    def _submit(self, prompt_ids, sampling, slo_class: str = "standard"):
+    def _submit(self, prompt_ids, sampling, slo_class: str = "standard",
+                tenant: str = ""):
         if self.supervisor is not None:
             return self.supervisor.submit(prompt_ids, sampling,
-                                          slo_class=slo_class)
+                                          slo_class=slo_class,
+                                          tenant=tenant)
         return self.service.submit(prompt_ids, sampling,
-                                   slo_class=slo_class)
+                                   slo_class=slo_class, tenant=tenant)
 
     def brownout_level(self) -> int:
         """Current brownout rung (0=normal, 1=degraded, 2=draining) from
@@ -321,9 +334,11 @@ class LocalEngineBackend(LLMBackend):
         return max(0.0, self._ema_ms_constrained - self._ema_ms_free)
 
     @classmethod
-    def from_config(cls, tpu_cfg, lifecycle=None) -> "LocalEngineBackend":
+    def from_config(cls, tpu_cfg, lifecycle=None,
+                    tenancy=None) -> "LocalEngineBackend":
         """Build from ``LLMConfig.tpu``: checkpoint weights or random-init
-        dev weights for the named preset."""
+        dev weights for the named preset.  ``tenancy`` (TenancyConfig)
+        arms the per-tenant admission governor and the KV fairness cap."""
         import jax
 
         # One normalization for the preflight AND the engine build below:
@@ -424,6 +439,9 @@ class LocalEngineBackend(LLMBackend):
         # params/tokenizer while the KV allocator and slot table start
         # from baseline by construction.  Weights are jax.Arrays the dead
         # engine never mutates, so reuse is safe.
+        max_kv_share = (float(tenancy.max_kv_share)
+                        if tenancy is not None else 1.0)
+
         def engine_factory() -> InferenceEngine:
             engine = InferenceEngine(
                 cfg,
@@ -431,7 +449,8 @@ class LocalEngineBackend(LLMBackend):
                 EngineConfig(max_slots=tpu_cfg.max_batch,
                              num_blocks=tpu_cfg.kv_blocks,
                              spec_k=tpu_cfg.spec_k,
-                             spec_min_accept=tpu_cfg.spec_min_accept),
+                             spec_min_accept=tpu_cfg.spec_min_accept,
+                             kv_max_tenant_share=max_kv_share),
                 tokenizer=tokenizer,
                 mesh=mesh,
             )
@@ -441,19 +460,32 @@ class LocalEngineBackend(LLMBackend):
             cls._install_verdict_grammar(engine, tokenizer)
             return engine
 
+        governor = None
+        if tenancy is not None and tenancy.enabled:
+            from k8s_llm_monitor_tpu.resilience.tenancy import TenantGovernor
+
+            governor = TenantGovernor(
+                requests_per_s=tenancy.requests_per_s,
+                request_burst=tenancy.request_burst,
+                tokens_per_s=tenancy.tokens_per_s,
+                token_burst=tenancy.token_burst,
+                enforce=tenancy.enforce,
+                max_tenants=tenancy.max_tenants)
+
         return cls(tokenizer=tokenizer, dev_weights=dev_weights,
-                   engine_factory=engine_factory, lifecycle=lifecycle)
+                   engine_factory=engine_factory, lifecycle=lifecycle,
+                   governor=governor)
 
     def generate(
         self, prompt: str, max_tokens: int = 512, temperature: float = 0.1,
-        slo_class: str = "standard",
+        slo_class: str = "standard", tenant: str = "",
     ) -> str:
         from k8s_llm_monitor_tpu.serving.engine import SamplingParams
 
         handle = self._submit(
             self.tokenizer.encode(prompt),
             SamplingParams(max_tokens=max_tokens, temperature=temperature),
-            slo_class=slo_class,
+            slo_class=slo_class, tenant=tenant,
         )
         res = handle.result(timeout=self.GENERATION_TIMEOUT_S)
         if res.finish_reason == "error":
@@ -464,7 +496,8 @@ class LocalEngineBackend(LLMBackend):
 
     def generate_constrained(self, prompt: str,
                              temperature: float = 0.0,
-                             slo_class: str = "standard") -> str:
+                             slo_class: str = "standard",
+                             tenant: str = "") -> str:
         """True grammar-constrained decoding: the verdict FSM's per-step
         logit masks run inside the engine's on-device sampler, so the raw
         token stream IS the verdict JSON — no post-hoc repair.  Falls back
@@ -479,14 +512,15 @@ class LocalEngineBackend(LLMBackend):
         if not has_grammar:
             return super().generate_constrained(prompt,
                                                 temperature=temperature,
-                                                slo_class=slo_class)
+                                                slo_class=slo_class,
+                                                tenant=tenant)
         handle = self._submit(
             self.tokenizer.encode(prompt),
             # max_tokens=1 is a floor: submit() raises it to the grammar's
             # max accepting path so the verdict can always close.
             SamplingParams(max_tokens=1, temperature=temperature,
                            constrained=True),
-            slo_class=slo_class,
+            slo_class=slo_class, tenant=tenant,
         )
         res = handle.result(timeout=self.GENERATION_TIMEOUT_S)
         if res.finish_reason == "error":
@@ -497,7 +531,7 @@ class LocalEngineBackend(LLMBackend):
 
     def generate_stream(
         self, prompt: str, max_tokens: int = 512, temperature: float = 0.1,
-        slo_class: str = "standard",
+        slo_class: str = "standard", tenant: str = "",
     ):
         """Yield decoded text increments as tokens come off the device.
 
@@ -509,7 +543,7 @@ class LocalEngineBackend(LLMBackend):
         handle = self._submit(
             self.tokenizer.encode(prompt),
             SamplingParams(max_tokens=max_tokens, temperature=temperature),
-            slo_class=slo_class,
+            slo_class=slo_class, tenant=tenant,
         )
         toks: list[int] = []
         emitted = ""
@@ -580,9 +614,10 @@ class OpenAICompatBackend(LLMBackend):
 
     def generate(
         self, prompt: str, max_tokens: int = 512, temperature: float = 0.1,
-        slo_class: str = "standard",
+        slo_class: str = "standard", tenant: str = "",
     ) -> str:
-        # slo_class ignored: the remote endpoint has its own admission.
+        # slo_class/tenant ignored: the remote endpoint has its own
+        # admission and accounting.
         body = json.dumps(
             {
                 "model": self.cfg.model,
@@ -634,10 +669,12 @@ class OpenAICompatBackend(LLMBackend):
 
 
 def build_backend(cfg: LLMConfig,
-                  lifecycle: LifecycleConfig | None = None) -> LLMBackend:
+                  lifecycle: LifecycleConfig | None = None,
+                  tenancy=None) -> LLMBackend:
     if cfg.provider == "tpu":
         try:
-            return LocalEngineBackend.from_config(cfg.tpu, lifecycle=lifecycle)
+            return LocalEngineBackend.from_config(cfg.tpu, lifecycle=lifecycle,
+                                                  tenancy=tenancy)
         except Exception as exc:  # noqa: BLE001 — degrade, never fail boot
             logger.warning(
                 "TPU backend unavailable (%s); falling back to template", exc
@@ -817,8 +854,8 @@ class AnalysisEngine:
 
     # -- free-form NL question (the missing /api/v1/query) ---------------------
 
-    def query(self, question: str,
-              slo_class: str = "interactive") -> AnalysisResponse:
+    def query(self, question: str, slo_class: str = "interactive",
+              tenant: str = "") -> AnalysisResponse:
         request_id = uuid.uuid4().hex[:12]
         try:
             ev = self.evidence.collect()
@@ -832,6 +869,7 @@ class AnalysisEngine:
                 max_tokens=self.llm_cfg.max_tokens,
                 temperature=self.llm_cfg.temperature,
                 slo_class=slo_class,
+                tenant=tenant,
             )
             return AnalysisResponse(
                 request_id=request_id,
@@ -856,7 +894,8 @@ class AnalysisEngine:
                 error_kind="internal",
             )
 
-    def query_stream(self, question: str, slo_class: str = "interactive"):
+    def query_stream(self, question: str, slo_class: str = "interactive",
+                     tenant: str = ""):
         """Streaming variant of query(): returns (request_id, model_name,
         iterator of answer-text chunks).  Evidence collection happens up
         front (before the first chunk); generation streams from the backend
@@ -874,11 +913,13 @@ class AnalysisEngine:
             max_tokens=self.llm_cfg.max_tokens,
             temperature=self.llm_cfg.temperature,
             slo_class=slo_class,
+            tenant=tenant,
         )
         return request_id, self.backend.name, chunks
 
     def query_session(self, question: str, session_id: str = "",
-                      slo_class: str = "interactive") -> AnalysisResponse:
+                      slo_class: str = "interactive",
+                      tenant: str = "") -> AnalysisResponse:
         """Multi-turn variant of ``query``: the cluster context is frozen
         at session creation and replayed verbatim as the prompt prefix on
         every follow-up, so the engine's PrefixCache (and fleet prefix
@@ -898,6 +939,7 @@ class AnalysisEngine:
                 max_tokens=self.llm_cfg.max_tokens,
                 temperature=self.llm_cfg.temperature,
                 slo_class=slo_class,
+                tenant=tenant,
             )
             session.record(question, answer)
             return AnalysisResponse(
@@ -925,7 +967,8 @@ class AnalysisEngine:
     # -- grammar-constrained verdicts -------------------------------------------
 
     def diagnose(self, question: str, context: str | None = None,
-                 slo_class: str = "standard") -> dict[str, Any]:
+                 slo_class: str = "standard",
+                 tenant: str = "") -> dict[str, Any]:
         """One grammar-constrained root-cause verdict as a parsed dict.
 
         The contract callers (pipeline, ``_analyze_root_cause``) rely on:
@@ -946,7 +989,7 @@ class AnalysisEngine:
         )
         text = self.backend.generate_constrained(
             prompt, temperature=self.llm_cfg.temperature,
-            slo_class=slo_class)
+            slo_class=slo_class, tenant=tenant)
         try:
             return parse_verdict(text)
         except GrammarError as exc:
@@ -960,7 +1003,8 @@ class AnalysisEngine:
 
     # -- typed analyses (ref pkg/models/models.go:85-99) ------------------------
 
-    def analyze(self, request: AnalysisRequest) -> AnalysisResponse:
+    def analyze(self, request: AnalysisRequest,
+                tenant: str = "") -> AnalysisResponse:
         request_id = uuid.uuid4().hex[:12]
         if request.type not in ANALYSIS_TYPES:
             return AnalysisResponse(
@@ -976,7 +1020,7 @@ class AnalysisEngine:
                 "anomaly_detection": self._analyze_anomalies,
                 "root_cause": self._analyze_root_cause,
             }[request.type]
-            result = handler(request.parameters or {})
+            result = handler(request.parameters or {}, tenant)
             return AnalysisResponse(
                 request_id=request_id, status="success", result=result
             )
@@ -998,7 +1042,8 @@ class AnalysisEngine:
                 error_kind="internal",
             )
 
-    def _analyze_pod_communication(self, params: dict[str, Any]) -> dict[str, Any]:
+    def _analyze_pod_communication(self, params: dict[str, Any],
+                                   tenant: str = "") -> dict[str, Any]:
         pod_a = params.get("pod_a", "")
         pod_b = params.get("pod_b", "")
         if not pod_a or not pod_b:
@@ -1019,6 +1064,7 @@ class AnalysisEngine:
         diagnosis = self.backend.generate(
             prompt, max_tokens=self.llm_cfg.max_tokens,
             temperature=self.llm_cfg.temperature,
+            tenant=tenant,
         )
         return {
             "analysis": to_jsonable(analysis),
@@ -1026,7 +1072,8 @@ class AnalysisEngine:
             "model": self.backend.name,
         }
 
-    def _analyze_anomalies(self, params: dict[str, Any]) -> dict[str, Any]:
+    def _analyze_anomalies(self, params: dict[str, Any],
+                           tenant: str = "") -> dict[str, Any]:
         ev = self.evidence.collect()
         anomalies: list[str] = []
         anomalies += [
@@ -1071,6 +1118,7 @@ class AnalysisEngine:
         summary = self.backend.generate(
             prompt, max_tokens=self.llm_cfg.max_tokens,
             temperature=self.llm_cfg.temperature,
+            tenant=tenant,
         )
         return {
             "anomalies": anomalies,
@@ -1080,7 +1128,8 @@ class AnalysisEngine:
             "model": self.backend.name,
         }
 
-    def _analyze_root_cause(self, params: dict[str, Any]) -> dict[str, Any]:
+    def _analyze_root_cause(self, params: dict[str, Any],
+                            tenant: str = "") -> dict[str, Any]:
         namespace = params.get("namespace", "default")
         pod = params.get("pod", "")
         symptom = params.get("symptom", "") or params.get("question", "")
@@ -1098,11 +1147,13 @@ class AnalysisEngine:
         answer = self.backend.generate(
             prompt, max_tokens=self.llm_cfg.max_tokens,
             temperature=self.llm_cfg.temperature,
+            tenant=tenant,
         )
         verdict = self.diagnose(
             f"Root-cause analysis for {target}."
             + (f" Reported symptom: {symptom}." if symptom else ""),
             context=self.evidence.format_prompt(ev),
+            tenant=tenant,
         )
         return {
             "target": target,

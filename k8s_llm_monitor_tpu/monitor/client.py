@@ -522,6 +522,7 @@ class ApiClient:
             retry_after_s=self._retry_hint_s(hint, slo_class),
             slo_class=slo_class,
             request_id=str(payload.get("request_id", "") or ""),
+            tenant=str(payload.get("tenant", "") or ""),
         )
 
     def _get_json(self, path: str) -> dict[str, Any]:
@@ -609,15 +610,18 @@ class ApiClient:
 
     # -- KV prefix migration (POST, never retried) ---------------------------
 
-    def kv_prefix(self, token_ids: list[int]) -> bytes | None:
+    def kv_prefix(self, token_ids: list[int],
+                  tenant: str = "") -> bytes | None:
         """POST /api/v1/kv/prefix — framed KV pages for the longest
-        cached prefix of ``token_ids`` (serving/kv_tier.py blob), or
-        None on a 404 cache miss."""
+        cached prefix of ``token_ids`` under ``tenant``'s namespace
+        (serving/kv_tier.py blob), or None on a 404 cache miss."""
         import urllib.error
 
+        body: dict[str, Any] = {"token_ids": [int(t) for t in token_ids]}
+        if tenant:
+            body["tenant"] = tenant
         try:
-            with self._open("/api/v1/kv/prefix",
-                            body={"token_ids": [int(t) for t in token_ids]},
+            with self._open("/api/v1/kv/prefix", body=body,
                             timeout=self.read_timeout_s) as resp:
                 return resp.read()
         except urllib.error.HTTPError as exc:
@@ -632,16 +636,20 @@ class ApiClient:
             raise ApiConnectionError(
                 f"POST /api/v1/kv/prefix: {exc}") from exc
 
-    def kv_install(self, blob: bytes) -> str:
+    def kv_install(self, blob: bytes, tenant: str | None = None) -> str:
         """POST /api/v1/kv/install — raw blob body; returns the engine's
         outcome string (``installed``/``cached``/``incompatible``/
-        ``nospace``)."""
+        ``nospace``/``tenant_mismatch``).  ``tenant`` rides the
+        ``X-Tenant-Id`` header (the body is the raw blob) and makes the
+        receiver refuse a blob whose header names someone else."""
         import json as _json
         import urllib.error
         import urllib.request
 
         headers = self._trace_headers()
         headers["Content-Type"] = "application/octet-stream"
+        if tenant:
+            headers["X-Tenant-Id"] = tenant
         req = urllib.request.Request(
             self._url("/api/v1/kv/install"), data=bytes(blob),
             headers=headers)
@@ -663,18 +671,24 @@ class ApiClient:
     # -- queries (POST, never retried) ---------------------------------------
 
     def query(self, question: str,
-              slo_class: str = "") -> dict[str, Any]:
+              slo_class: str = "", tenant: str = "") -> dict[str, Any]:
         body: dict[str, Any] = {"question": question}
         if slo_class:
             body["slo_class"] = slo_class
+        if tenant:
+            body["tenant"] = tenant
         return self._post_json("/api/v1/query", body,
                                timeout=self.read_timeout_s)
 
-    def analyze(self, payload: dict[str, Any]) -> dict[str, Any]:
+    def analyze(self, payload: dict[str, Any],
+                tenant: str = "") -> dict[str, Any]:
+        if tenant:
+            payload = dict(payload, tenant=tenant)
         return self._post_json("/api/v1/analyze", payload,
                                timeout=self.read_timeout_s)
 
-    def query_stream(self, question: str, slo_class: str = ""):
+    def query_stream(self, question: str, slo_class: str = "",
+                     tenant: str = ""):
         """POST /api/v1/query with ``stream: true``; returns
         ``(request_id, model, deltas)`` where ``deltas`` yields answer-text
         chunks.  Mid-stream socket death raises ``ApiConnectionError`` from
@@ -685,6 +699,8 @@ class ApiClient:
         body: dict[str, Any] = {"question": question, "stream": True}
         if slo_class:
             body["slo_class"] = slo_class
+        if tenant:
+            body["tenant"] = tenant
         try:
             resp = self._open("/api/v1/query", body=body,
                               timeout=self.read_timeout_s)

@@ -49,6 +49,8 @@ ENV_KEYS: dict[str, str] = {
     "K8SLLM_JOURNAL_FSYNC": "runtime:resilience/journal.py",
     "K8SLLM_LOCKCHECK": "runtime:devtools/lockcheck.py",
     "K8SLLM_LOCKCHECK_HOLD_MS": "runtime:devtools/lockcheck.py",
+    "K8SLLM_TENANT_ENFORCE": "runtime:resilience/tenancy.py",
+    "K8SLLM_TENANT_DEFAULT": "runtime:resilience/tenancy.py",
 }
 
 
@@ -351,6 +353,37 @@ class AutoscaleConfig:
 
 
 @dataclass
+class TenancyConfig:
+    """Multi-tenant admission quotas + KV fairness (resilience/tenancy.py).
+    New; no reference equivalent — the Go reference had no admission layer
+    to partition."""
+
+    enabled: bool = True
+    # Refuse over-quota requests with tenant-tagged 429s.  False keeps the
+    # full per-tenant accounting but never refuses (single-tenant default);
+    # K8SLLM_TENANT_ENFORCE=1 flips enforcement on without a config change.
+    enforce: bool = True
+    # Per-tenant request-rate bucket; rate 0 leaves the dimension
+    # unlimited (burst 0 derives from the rate).
+    requests_per_s: float = 0.0
+    request_burst: float = 0.0
+    # Per-tenant generated-token quota bucket: max_tokens is reserved at
+    # admission and the unused remainder refunded at settlement.
+    tokens_per_s: float = 0.0
+    token_burst: float = 0.0
+    # KV fairness: fraction of resident prefix-cache blocks (device) /
+    # bytes (host tier) one tenant may hold while another is resident;
+    # 1.0 disables the cap.
+    max_kv_share: float = 1.0
+    # Exporter cardinality cap: per-tenant metric families emit the top-K
+    # tenants by admitted requests plus one aggregate "other" bucket.
+    top_k_metrics: int = 8
+    # Governor state cap: longest-idle tenants with nothing in flight are
+    # evicted past this many distinct tenants.
+    max_tenants: int = 1024
+
+
+@dataclass
 class LoggingConfig:
     level: str = "info"
     format: str = "json"  # ref config.go default
@@ -371,6 +404,7 @@ class Config:
     fleet: FleetConfig = field(default_factory=FleetConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
+    tenancy: TenancyConfig = field(default_factory=TenancyConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
 
 

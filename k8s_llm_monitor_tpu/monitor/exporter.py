@@ -733,6 +733,58 @@ def _telemetry_metrics(w: _Writer, scraper) -> None:
              [("", t["dropped_series_total"])])
 
 
+def _tenant_metrics(w: _Writer, srv) -> None:
+    """Per-tenant admission/quota/KV families (resilience/tenancy.py).
+
+    Cardinality discipline: the ``tenant`` label is capped at the top-K
+    tenants by admitted requests plus ONE aggregate ``other`` bucket
+    (always emitted, 0 when nothing spilled), so an abusive client
+    minting fresh tenant ids can grow the scrape by exactly nothing.
+    K comes from ``config.tenancy.top_k_metrics``.
+    """
+    gov = getattr(srv, "governor", None)
+    if gov is None:
+        return
+    snap = gov.snapshot()
+    tcfg = getattr(getattr(srv, "config", None), "tenancy", None)
+    top_k = max(1, int(getattr(tcfg, "top_k_metrics", 8) or 8))
+    # Device-resident prefix-cache blocks join on the same label set.
+    blocks: dict[str, int] = {}
+    svc = srv.engine_service() if hasattr(srv, "engine_service") else None
+    engine = getattr(svc, "engine", None)
+    tier_fn = getattr(engine, "kv_tier_stats", None)
+    if callable(tier_fn):
+        blocks = dict(tier_fn().get("tenant_blocks") or {})
+    ranked = sorted(snap, key=lambda t: (-snap[t]["admitted"], t))
+    shown = ranked[:top_k]
+    spilled = ranked[top_k:]
+    kv_spilled = [t for t in blocks if t not in shown]
+
+    def rows(per_tenant, other_value):
+        return ([(f'{{tenant="{t}"}}', per_tenant(t)) for t in shown]
+                + [('{tenant="other"}', other_value)])
+
+    w.metric("tenant_requests_total", "counter",
+             "Requests admitted per tenant (top-K by volume; the rest "
+             "aggregate under tenant=\"other\")",
+             rows(lambda t: snap[t]["admitted"],
+                  sum(snap[t]["admitted"] for t in spilled)))
+    w.metric("tenant_shed_total", "counter",
+             "Refusals charged per tenant: quota 429s plus SLO-class "
+             "sheds downstream of admission",
+             rows(lambda t: snap[t]["sheds"],
+                  sum(snap[t]["sheds"] for t in spilled)))
+    w.metric("tenant_kv_blocks", "gauge",
+             "Distinct device prefix-cache blocks resident per tenant "
+             "namespace (the fairness cap's accounting)",
+             rows(lambda t: blocks.get(t, 0),
+                  sum(blocks[t] for t in kv_spilled)))
+    w.metric("tenant_quota_remaining", "gauge",
+             "Token-quota bucket level per tenant (-1 = unlimited; NaN "
+             "for the aggregate bucket — levels do not sum)",
+             rows(lambda t: snap[t]["quota_remaining"], float("nan")))
+
+
 def _tracing_metrics(w: _Writer) -> None:
     """Tracer + flight-recorder self-accounting."""
     from k8s_llm_monitor_tpu.observability.flight import get_flight_recorder
@@ -790,6 +842,7 @@ def render_prometheus(srv: "MonitorServer", openmetrics: bool = False) -> str:
     scraper = getattr(srv, "signals", None)
     if scraper is not None:
         _telemetry_metrics(w, scraper)
+    _tenant_metrics(w, srv)
     _tracing_metrics(w)
     _device_metrics(w)
     # Render-time self-lint: a malformed family poisons the whole scrape

@@ -46,6 +46,7 @@ from k8s_llm_monitor_tpu.observability.tracing import (
 )
 from k8s_llm_monitor_tpu.resilience.errors import OverloadedError
 from k8s_llm_monitor_tpu.resilience.slo import normalize_slo_class
+from k8s_llm_monitor_tpu.resilience.tenancy import normalize_tenant
 from k8s_llm_monitor_tpu.serving.kv_tier import BlobError
 
 logger = logging.getLogger("monitor.server")
@@ -101,6 +102,12 @@ class MonitorServer:
         # fleet.autoscaler.AutoscaleController on router-role processes
         # with autoscale.enabled; wired by frontend.build_router_server.
         self.autoscaler = None
+        # resilience.tenancy.TenantGovernor: per-tenant admission quotas.
+        # Wired by build_server (single-replica: the backend's governor)
+        # or build_router_server (fleet: the router's); None in dev mode
+        # or with tenancy.enabled=false.  Read by /api/v1/stats and the
+        # exporter's tenant_* families.
+        self.governor = None
         self._httpd: ThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -245,6 +252,11 @@ class MonitorServer:
             }
             if self.autoscaler is not None:
                 snap["fleet"]["autoscaler"] = self.autoscaler.snapshot()
+        if self.governor is not None:
+            # Per-tenant accounting: admissions, quota refusals, sheds,
+            # charged (delivered) tokens, in-flight reservations, and the
+            # remaining token quota (-1 = unlimited).
+            snap["tenants"] = self.governor.snapshot()
         return snap
 
     # -- lifecycle -------------------------------------------------------------
@@ -362,6 +374,10 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
                     "queue_depth": exc.queue_depth,
                     "queue_tokens": exc.queue_tokens,
                     "slo_class": exc.slo_class,
+                    # Tenant-tagged refusals: a quota 429 names the tenant
+                    # it throttled, so client-side balancers back off the
+                    # right traffic class (empty for untenanted refusals).
+                    "tenant": exc.tenant,
                     # Assigned before the refusal: lets clients join the
                     # 429/503 with traces, logs, and the journal.
                     "request_id": exc.request_id,
@@ -379,6 +395,15 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+
+        def _parse_tenant(self, body: dict[str, Any] | None = None) -> str:
+            """Tenant identity at the trust boundary: the ``X-Tenant-Id``
+            header wins over the body's ``"tenant"`` key; absent both,
+            the default tenant.  Malformed ids raise ValueError — callers
+            map it to a 400 before any engine work happens."""
+            raw = (self.headers.get("X-Tenant-Id")
+                   or (body or {}).get("tenant") or "")
+            return normalize_tenant(raw)
 
         def _read_json(self) -> dict[str, Any]:
             """Parse the body as a JSON object; raises ValueError (which
@@ -734,10 +759,11 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
                 # callers may opt down to "standard" or "batch".
                 slo_class = normalize_slo_class(
                     str(body.get("slo_class") or ""), default="interactive")
+                tenant = self._parse_tenant(body)
             except ValueError as exc:
                 return self._send_error_text(str(exc), 400)
             if body.get("stream"):
-                return self._stream_query(question, slo_class)
+                return self._stream_query(question, slo_class, tenant)
             # Multi-turn follow-ups: "session_id" (even "", which mints a
             # new session) pins the conversation to one frozen cluster
             # context whose token prefix replays every turn — PrefixCache
@@ -748,9 +774,10 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
                         "sessions are not supported on this role", 400)
                 resp = srv.analysis.query_session(
                     question, str(body.get("session_id") or ""),
-                    slo_class=slo_class)
+                    slo_class=slo_class, tenant=tenant)
             else:
-                resp = srv.analysis.query(question, slo_class=slo_class)
+                resp = srv.analysis.query(question, slo_class=slo_class,
+                                          tenant=tenant)
             self._send_json(resp, status=200 if resp.status == "success" else 500)
 
         def h_diagnoses(self) -> None:
@@ -853,14 +880,15 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
             })
 
         def _stream_query(self, question: str,
-                          slo_class: str = "interactive") -> None:
+                          slo_class: str = "interactive",
+                          tenant: str = "") -> None:
             """Server-sent events: one `data:` JSON per answer-text delta as
             tokens come off the device, then a final done event.  TTFT is
             real for clients here — the first delta arrives while the rest
             of the answer is still decoding."""
             try:
                 request_id, model, chunks = srv.analysis.query_stream(
-                    question, slo_class=slo_class)
+                    question, slo_class=slo_class, tenant=tenant)
             except OverloadedError as exc:  # headers not sent yet: 429/503
                 return self._send_overloaded(exc)
             except Exception as exc:  # noqa: BLE001 — before headers: 500
@@ -907,12 +935,16 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
                 body = self._read_json() or {}
             except ValueError:
                 return self._send_error_text("Invalid JSON body", 400)
+            try:
+                tenant = self._parse_tenant(body)
+            except ValueError as exc:
+                return self._send_error_text(str(exc), 400)
             req = AnalysisRequest(
                 type=body.get("type", ""),
                 parameters=body.get("parameters") or {},
                 context=body.get("context") or {},
             )
-            resp = srv.analysis.analyze(req)
+            resp = srv.analysis.analyze(req, tenant=tenant)
             if resp.status == "success":
                 return self._send_json(resp)
             # validation errors are the caller's fault; everything else is a
@@ -948,8 +980,13 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
                 return self._send_error_text(
                     "token_ids must be a non-empty list of ints", 400)
             try:
+                tenant = self._parse_tenant(body)
+            except ValueError as exc:
+                return self._send_error_text(str(exc), 400)
+            try:
                 blob = self._engine_call(
-                    lambda e: e.export_prefix([int(t) for t in ids]))
+                    lambda e: e.export_prefix([int(t) for t in ids],
+                                              tenant=tenant))
             except LookupError:
                 return self._send_error_text(
                     "Engine not available - running in development mode",
@@ -965,15 +1002,26 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
         def h_kv_install(self) -> None:
             """Install a fetched prefix blob (raw octet-stream body) into
             the local KV pool; responds with the engine's outcome string
-            (``installed``/``cached``/``incompatible``/``nospace``).
+            (``installed``/``cached``/``incompatible``/``nospace``/
+            ``tenant_mismatch``).  The body is the raw blob, so tenant
+            identity rides only on the ``X-Tenant-Id`` header: when set,
+            a blob packed under a different tenant's namespace is refused
+            as ``tenant_mismatch``; absent, the blob's own header rules.
             Framing/CRC damage is the sender's fault: 400."""
+            raw_tenant = self.headers.get("X-Tenant-Id") or ""
+            try:
+                expected = (normalize_tenant(raw_tenant)
+                            if raw_tenant else None)
+            except ValueError as exc:
+                return self._send_error_text(str(exc), 400)
             length = int(self.headers.get("Content-Length", 0) or 0)
             blob = self.rfile.read(length) if length else b""
             if not blob:
                 return self._send_error_text("empty blob", 400)
             try:
                 outcome = self._engine_call(
-                    lambda e: e.install_prefix(blob))
+                    lambda e: e.install_prefix(blob,
+                                               expected_tenant=expected))
             except LookupError:
                 return self._send_error_text(
                     "Engine not available - running in development mode",
@@ -1250,7 +1298,8 @@ def build_server(
             client = None
     if client is not None and config.metrics.enabled:
         manager = Manager(client, config.metrics, uav_fetcher=uav_fetcher)
-    llm_backend = build_backend(config.llm, lifecycle=config.lifecycle)
+    llm_backend = build_backend(config.llm, lifecycle=config.lifecycle,
+                                tenancy=config.tenancy)
     detector = None
     if config.analysis.embedding_model:
         try:
@@ -1312,6 +1361,10 @@ def build_server(
         diagnosis=diagnosis,
         signals=signals,
     )
+    # Single-replica tenancy: the backend's governor (None for remote/
+    # template backends or tenancy.enabled=false) feeds /api/v1/stats
+    # and the exporter's tenant_* families.
+    srv.governor = getattr(llm_backend, "governor", None)
     if signals is not None:
         signals.attach(srv)
         # Crash-edge dumps (flight recorder v2) carry the trailing

@@ -15,7 +15,11 @@ point, and one observable surface:
   * ``journal`` — :class:`RequestJournal`, the append-only request WAL
     behind the crash-safe lifecycle (serving/supervisor.py replays it);
   * ``errors``  — :class:`OverloadedError`, the admission-refusal error
-    the HTTP layer maps to 429/503 + Retry-After.
+    the HTTP layer maps to 429/503 + Retry-After;
+  * ``tenancy`` — :func:`normalize_tenant` / :func:`tenant_seed` and the
+    per-tenant :class:`TenantGovernor` (request-rate + token-quota
+    admission, reservation-settled so fleet hedges/failovers can't
+    double-charge).
 
 Everything here is stdlib-only and CPU-deterministic (seeded RNGs,
 injectable clocks) so chaos tests reproduce bit-identically in CI.
@@ -45,6 +49,13 @@ from k8s_llm_monitor_tpu.resilience.retry import (
     CircuitBreaker,
     CircuitOpen,
 )
+from k8s_llm_monitor_tpu.resilience.tenancy import (
+    DEFAULT_TENANT,
+    TenantGovernor,
+    TokenBucket,
+    normalize_tenant,
+    tenant_seed,
+)
 
 __all__ = [
     "FAULT_POINTS",
@@ -63,4 +74,9 @@ __all__ = [
     "DEGRADED",
     "DRAINING",
     "UNHEALTHY",
+    "DEFAULT_TENANT",
+    "TenantGovernor",
+    "TokenBucket",
+    "normalize_tenant",
+    "tenant_seed",
 ]

@@ -25,7 +25,7 @@ class OverloadedError(Exception):
     def __init__(self, reason: str, queue_depth: int = 0,
                  queue_tokens: int = 0, retriable: bool = True,
                  retry_after_s: float = 1.0, slo_class: str = "",
-                 request_id: str = ""):
+                 request_id: str = "", tenant: str = ""):
         super().__init__(
             f"overloaded: {reason} "
             f"(queue_depth={queue_depth}, queue_tokens={queue_tokens})")
@@ -34,6 +34,10 @@ class OverloadedError(Exception):
         self.queue_tokens = queue_tokens
         self.retriable = retriable
         self.retry_after_s = retry_after_s
+        # Tenant the refusal is charged to ('' when the shedding layer is
+        # tenant-unaware): the HTTP layer echoes it in the 429 body so a
+        # rate-limited tenant can see the quota is *theirs*, not global.
+        self.tenant = tenant
         # SLO class of the shed request ('' when the shed predates class
         # plumbing or the layer doesn't know): clients use it to pick the
         # per-class backoff lane, the HTTP layer echoes it in the 429 body.

@@ -81,6 +81,11 @@ class JournaledRequest:
     completed: bool = False
     # SLO class (resilience/slo.py); pre-class WALs default to "standard".
     slo_class: str = "standard"
+    # Tenant namespace (resilience/tenancy.py); pre-tenancy WALs default
+    # to "public" — replay restores per-tenant quota reservations from
+    # this field, so a crash cannot launder one tenant's quota into
+    # another's.
+    tenant: str = "public"
 
 
 def _pack(rtype: int, payload: dict[str, Any]) -> bytes:
@@ -169,6 +174,7 @@ def scan_journal(directory: str | Path) -> tuple[dict[str, JournaledRequest], bo
                 req.deadline_s = float(payload.get("deadline_s", 0.0))
                 req.arrival_unix = float(payload.get("arrival", 0.0))
                 req.slo_class = str(payload.get("slo_class", "standard"))
+                req.tenant = str(payload.get("tenant", "public"))
             elif rtype == PROGRESS:
                 req = requests.get(rid)
                 if req is None:
@@ -306,10 +312,12 @@ class RequestJournal:
     def log_admit(self, request_id: str, prompt_ids: list[int],
                   sampling: Any, deadline_s: float = 0.0,
                   arrival_unix: float | None = None,
-                  slo_class: str = "standard") -> None:
+                  slo_class: str = "standard",
+                  tenant: str = "public") -> None:
         """Journal an accepted request BEFORE it reaches the engine
         (write-ahead).  ``sampling`` may be a SamplingParams dataclass or a
-        plain dict."""
+        plain dict.  ``tenant`` rides the ADMIT record so a warm-start
+        replay restores per-tenant quota reservations exactly."""
         if dataclasses.is_dataclass(sampling):
             sampling = dataclasses.asdict(sampling)
         payload = {
@@ -319,6 +327,7 @@ class RequestJournal:
             "deadline_s": float(deadline_s),
             "arrival": time.time() if arrival_unix is None else arrival_unix,
             "slo_class": slo_class,
+            "tenant": tenant,
         }
         with self._lock:
             self._live_refs.setdefault(request_id, set()).add(self._seg_index)

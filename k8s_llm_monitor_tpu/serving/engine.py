@@ -68,6 +68,10 @@ from k8s_llm_monitor_tpu.observability.metrics import ClassHistogram
 from k8s_llm_monitor_tpu.observability.tracing import get_tracer
 from k8s_llm_monitor_tpu.resilience.faults import FaultError, get_injector
 from k8s_llm_monitor_tpu.resilience.slo import DEFAULT_CLASS, SLO_RANK
+from k8s_llm_monitor_tpu.resilience.tenancy import (
+    DEFAULT_TENANT,
+    normalize_tenant,
+)
 from k8s_llm_monitor_tpu.ops.sampling import (
     fsm_advance,
     fsm_mask_logits,
@@ -140,6 +144,11 @@ class GenerationRequest:
     # Host-side scheduling metadata only — orders admission, shedding, and
     # eviction; never enters a traced program (zero recompiles).
     slo_class: str = DEFAULT_CLASS
+    # Tenant namespace (resilience/tenancy.py): seeds this request's
+    # prefix-cache digest chain, so its KV reuse is confined to its own
+    # tenant by construction.  Host-side scheduling metadata only, like
+    # slo_class — never enters a traced program (zero recompiles).
+    tenant: str = DEFAULT_TENANT
     # Trace context (observability/tracing.py TraceContext) captured at
     # EngineService.submit; the engine records phase spans against it.
     # Host-side metadata only, like slo_class — never enters a traced
@@ -228,6 +237,12 @@ class EngineConfig:
     # 0 disables.  Shared blocks are read-only by construction, so this is
     # refcounting, not copy-on-write.
     prefix_cache_entries: int = 1024
+    # Multi-tenant KV fairness (resilience/tenancy.py): the fraction of
+    # cached blocks (device prefix cache) / bytes (host tier) one tenant
+    # may hold while another tenant is resident — over-share tenants
+    # become the preferred eviction victims of THEIR OWN LRU entries.
+    # 1.0 disables the cap (single-tenant default).
+    kv_max_tenant_share: float = 1.0
     # Prefill-priority: while chunk rounds are pending, decode dispatches
     # only every Nth step — TTFT is completion-order-sensitive and a decode
     # dispatch between chunk rounds would steal ~half the bandwidth from
@@ -540,7 +555,8 @@ class InferenceEngine:
         self.pages = pages
         self.allocator = BlockAllocator(ec.num_blocks, ec.block_size)
         self.prefix_cache: Optional[PrefixCache] = (
-            PrefixCache(self.allocator, ec.prefix_cache_entries)
+            PrefixCache(self.allocator, ec.prefix_cache_entries,
+                        max_tenant_share=ec.kv_max_tenant_share)
             if ec.prefix_cache_entries > 0 else None)
         # Cold-burst shared-prefix dedup: requests whose admission waited
         # for an in-flight lane to publish their prefix.
@@ -549,7 +565,8 @@ class InferenceEngine:
         # (the supervisor's engine_factory closes over one) survives engine
         # rebuilds, so spilled prefixes outlive a crash-recovery cycle.
         if host_kv_tier is None and ec.host_spill_bytes > 0:
-            host_kv_tier = HostKVTier(ec.host_spill_bytes)
+            host_kv_tier = HostKVTier(ec.host_spill_bytes,
+                                      max_tenant_share=ec.kv_max_tenant_share)
         self.host_kv_tier = host_kv_tier
         # Rehydration scatter programs, one per (leaf dtype, padded row
         # count): leaf.at[idx].set(rows) with donated leaf, so a restore
@@ -927,6 +944,10 @@ class InferenceEngine:
             raise ValueError("empty prompt")
         if req.sampling.max_tokens < 1:
             raise ValueError("max_tokens must be >= 1")
+        # Defense in depth: the trust boundary (service/HTTP) normalized
+        # already, but a raw-engine caller must not smuggle an unvalidated
+        # namespace into the digest seeds.
+        req.tenant = normalize_tenant(req.tenant, default=DEFAULT_TENANT)
         if req.sampling.constrained:
             if self._grammar is None:
                 raise ValueError(
@@ -1575,9 +1596,14 @@ class InferenceEngine:
             peek = pc.peek_lru()
             if peek is not None:
                 digest, blocks = peek
+                # The victim's namespace follows it to the host tier (the
+                # digest is already tenant-seeded; the tag drives the
+                # tier's per-tenant byte accounting + max-share cap).
+                victim_tenant = pc.peek_lru_tenant() or DEFAULT_TENANT
                 t_spill = time.monotonic()
                 try:
-                    tier.put(digest, self._fetch_rows(blocks))
+                    tier.put(digest, self._fetch_rows(blocks),
+                             tenant=victim_tenant)
                 except Exception as exc:  # noqa: BLE001 — spill must never block eviction
                     logger.warning("KV spill failed (%s); dropping entry",
                                    exc)
@@ -1652,7 +1678,8 @@ class InferenceEngine:
                                    v_scale=new_vs if quant else ())
 
     def _try_restore(self, prompt_ids: list[int], shared: list[int],
-                     shared_toks: int) -> tuple[list[int], int]:
+                     shared_toks: int, *,
+                     tenant: str = DEFAULT_TENANT) -> tuple[list[int], int]:
         """Host-tier lookup behind a device prefix-cache miss (or a
         shorter-than-spilled hit): rehydrate the longest spilled prefix of
         ``prompt_ids`` into freshly allocated blocks, re-register it, and
@@ -1668,7 +1695,7 @@ class InferenceEngine:
         have = shared_toks // bs
         if n <= have:
             return shared, shared_toks
-        digests = pc.digest_chain(prompt_ids, n)
+        digests = pc.digest_chain(prompt_ids, n, tenant=tenant)
         for k in range(n, have, -1):
             dg = digests[k - 1]
             entry = tier.peek(dg)
@@ -1695,7 +1722,7 @@ class InferenceEngine:
             # guarantees len(prompt_ids) > k*bs, so the +1 slice below is
             # always in range; the extra token only satisfies the
             # shareable-span rule (digests cover whole blocks).
-            pc.register(prompt_ids[:k * bs + 1], blocks)
+            pc.register(prompt_ids[:k * bs + 1], blocks, tenant=tenant)
             if shared:
                 self.allocator.free(shared)
             return blocks, k * bs
@@ -1717,16 +1744,19 @@ class InferenceEngine:
             "page_dtype": np.dtype(self.pages.k[0].dtype).name,
         }
 
-    def export_prefix(self, prompt_ids: list[int]) -> Optional[bytes]:
-        """Frame the longest cached prefix of ``prompt_ids`` for a
-        replica-to-replica transfer (the fleet page-fetch endpoint).
-        Returns None on a miss.  The lookup's increfs pin the blocks for
-        the duration of the device fetch, then release — export never
-        changes cache contents."""
+    def export_prefix(self, prompt_ids: list[int], *,
+                      tenant: str = DEFAULT_TENANT) -> Optional[bytes]:
+        """Frame the longest cached prefix of ``prompt_ids`` (within
+        ``tenant``'s namespace) for a replica-to-replica transfer (the
+        fleet page-fetch endpoint).  Returns None on a miss.  The blob's
+        META carries the tenant, so the receiver can refuse a namespace
+        mismatch before touching pages.  The lookup's increfs pin the
+        blocks for the duration of the device fetch, then release —
+        export never changes cache contents."""
         pc = self.prefix_cache
         if pc is None:
             return None
-        shared, shared_toks = pc.lookup(prompt_ids)
+        shared, shared_toks = pc.lookup(prompt_ids, tenant=tenant)
         if not shared:
             return None
         try:
@@ -1734,24 +1764,38 @@ class InferenceEngine:
             meta = dict(
                 self._kv_geometry(),
                 n_blocks=len(shared),
-                tokens=[int(t) for t in prompt_ids[:shared_toks]])
+                tokens=[int(t) for t in prompt_ids[:shared_toks]],
+                tenant=tenant)
             return pack_prefix_blob(
                 meta, [a for leaf in entry.layers for a in leaf])
         finally:
             self.allocator.free(shared)
 
-    def install_prefix(self, blob: bytes) -> str:
+    def install_prefix(self, blob: bytes, *,
+                       expected_tenant: str | None = None) -> str:
         """Install a migrated prefix blob into the local pool and prefix
-        cache.  Returns an outcome string: ``"installed"`` (pages written
-        and registered), ``"cached"`` (already resident — no work),
-        ``"incompatible"`` (geometry contract mismatch), or ``"nospace"``
-        (pool pressure won).  Framing/CRC damage raises
-        :class:`~..serving.kv_tier.BlobError` — the caller treats a torn
-        transfer as a miss, never a partial install."""
+        cache (under the blob's own tenant namespace).  Returns an outcome
+        string: ``"installed"`` (pages written and registered),
+        ``"cached"`` (already resident — no work), ``"incompatible"``
+        (geometry contract mismatch), ``"tenant_mismatch"`` (the caller
+        expected a different namespace than the blob header claims — the
+        pages are refused unseen), or ``"nospace"`` (pool pressure won).
+        Framing/CRC damage raises :class:`~..serving.kv_tier.BlobError` —
+        the caller treats a torn transfer as a miss, never a partial
+        install."""
         meta, raw = unpack_prefix_blob(blob)
         geo = self._kv_geometry()
         if any(meta.get(key) != geo[key] for key in geo):
             return "incompatible"
+        # Blobs packed before tenancy landed carry no tenant header and
+        # install into the default namespace (back-compat).
+        try:
+            blob_tenant = normalize_tenant(
+                meta.get("tenant"), default=DEFAULT_TENANT)
+        except ValueError:
+            return "incompatible"
+        if expected_tenant is not None and blob_tenant != expected_tenant:
+            return "tenant_mismatch"
         pc = self.prefix_cache
         cfg, ec = self.cfg, self.ecfg
         bs = ec.block_size
@@ -1764,7 +1808,7 @@ class InferenceEngine:
         # The +1 probe/register token never enters a digest (whole blocks
         # only); it just satisfies the shareable-span rule.
         probe = tokens + [0]
-        shared, st = pc.lookup(probe)
+        shared, st = pc.lookup(probe, tenant=blob_tenant)
         if shared:
             self.allocator.free(shared)
             if st >= k * bs:
@@ -1796,7 +1840,7 @@ class InferenceEngine:
         except Exception:
             self.allocator.free(blocks)
             raise
-        pc.register(probe, blocks)
+        pc.register(probe, blocks, tenant=blob_tenant)
         # The cache entries hold their own references now; dropping the
         # alloc-time ref leaves the pages owned by the cache alone (LRU
         # evictable, host-spillable) exactly like a locally prefilled span.
@@ -1827,7 +1871,12 @@ class InferenceEngine:
             s = self.host_kv_tier.stats()
             out.update(host_bytes=s["bytes"], host_entries=s["entries"],
                        spills=s["spills"], restores=s["restores"],
-                       host_lost=s["lost"])
+                       host_lost=s["lost"],
+                       host_tenant_bytes=s["tenant_bytes"])
+        # Per-tenant resident-block fairness accounting (exporter
+        # ``tenant_kv_blocks`` + the bench's monopoly probe).
+        if self.prefix_cache is not None:
+            out["tenant_blocks"] = self.prefix_cache.blocks_by_tenant()
         return out
 
     def _pending_prefix_gain(
@@ -1929,7 +1978,8 @@ class InferenceEngine:
             shared: list[int] = []
             shared_toks = 0
             if self.prefix_cache is not None:
-                shared, shared_toks = self.prefix_cache.lookup(req.prompt_ids)
+                shared, shared_toks = self.prefix_cache.lookup(
+                    req.prompt_ids, tenant=req.tenant)
                 if self.host_kv_tier is not None:
                     # A spilled entry longer than the device hit rehydrates
                     # here, overlapped with the rest of admission prep —
@@ -1938,7 +1988,8 @@ class InferenceEngine:
                     t_res = time.monotonic()
                     pre_toks = shared_toks
                     shared, shared_toks = self._try_restore(
-                        req.prompt_ids, shared, shared_toks)
+                        req.prompt_ids, shared, shared_toks,
+                        tenant=req.tenant)
                     if shared_toks > pre_toks:
                         self._span("engine.kv_restore", t_res,
                                    time.monotonic(), req,
@@ -2136,7 +2187,8 @@ class InferenceEngine:
             self.prefill_bucket_rounds.get(bucket, 0) + 1)
         if self.prefix_cache is not None:
             for slot_idx, req, blocks, st in batch:
-                self.prefix_cache.register(req.prompt_ids, blocks)
+                self.prefix_cache.register(req.prompt_ids, blocks,
+                                           tenant=req.tenant)
         self._finish_admit_dispatch(
             first, [(s, r, b) for s, r, b, _ in batch], idx, fsm_next=fnext,
             span_attrs={"bucket": bucket, "lanes": len(batch),
@@ -2272,7 +2324,8 @@ class InferenceEngine:
         self.prefill_bucket_rounds[bucket] = (
             self.prefill_bucket_rounds.get(bucket, 0) + 1)
         for s in to_register:
-            self.prefix_cache.register(s.req.prompt_ids, s.blocks)
+            self.prefix_cache.register(s.req.prompt_ids, s.blocks,
+                                       tenant=s.req.tenant)
         self.prefills += len(lanes)
         self._queue_inflight("chunk", first, idx, lanes, touched,
                              fsm_next=fnext,

@@ -26,6 +26,16 @@ Prefix sharing design (TPU-first, no copy-on-write needed):
     reuses the longest cached prefix; eviction decrefs, and blocks still
     referenced by live slots survive.  LRU order lives in dict insertion
     order (touch = pop + reinsert), so eviction is O(1).
+  * The chain seed is the caller's *tenant* namespace digest
+    (``resilience.tenancy.tenant_seed``), not ``b""`` — two tenants hashing
+    identical token prefixes produce disjoint digest chains, so a
+    cross-tenant prefix hit is structurally impossible (the privacy
+    invariant docs/resilience.md "Tenancy & quotas" states, and
+    graftcheck's ``tenant-namespace`` rule gates at every call site).
+    Eviction is fairness-aware: when one tenant's resident blocks exceed
+    ``max_tenant_share`` of the cached total (and another tenant is
+    present), pressure evicts *that tenant's* LRU entry first, so no
+    tenant can monopolize the device pool.
 
 Every diagnosis query shares the system preamble + evidence prefix
 (monitor/analysis.py builds them), so at 100 concurrent the prefix is
@@ -57,6 +67,7 @@ import hashlib
 import numpy as np
 
 from k8s_llm_monitor_tpu.resilience.faults import get_injector
+from k8s_llm_monitor_tpu.resilience.tenancy import DEFAULT_TENANT, tenant_seed
 
 
 def shareable_blocks(n_tokens: int, block_size: int) -> int:
@@ -171,6 +182,7 @@ class BlockAllocator:
 @dataclasses.dataclass
 class _PrefixEntry:
     blocks: tuple[int, ...]     # cache-owned refs (one per block)
+    tenant: str = DEFAULT_TENANT  # namespace owner (fairness accounting)
 
 
 class PrefixCache:
@@ -184,9 +196,14 @@ class PrefixCache:
     lookup retried for a deferred request must not double-count).
     """
 
-    def __init__(self, allocator: BlockAllocator, max_entries: int = 512):
+    def __init__(self, allocator: BlockAllocator, max_entries: int = 512,
+                 max_tenant_share: float = 1.0):
         self.allocator = allocator
         self.max_entries = max_entries
+        # Fairness cap: once >1 tenant is resident, a tenant holding more
+        # than this fraction of the cached blocks becomes the preferred
+        # eviction victim (1.0 = no cap).
+        self.max_tenant_share = float(max_tenant_share)
         # Insertion-ordered: first key is always the LRU entry (touch =
         # pop + reinsert), so eviction never scans.
         self._entries: dict[bytes, _PrefixEntry] = {}
@@ -197,11 +214,13 @@ class PrefixCache:
     def __len__(self) -> int:
         return len(self._entries)
 
-    def _chain_digests(self, prompt_ids: list[int], n_blocks: int) -> list[bytes]:
-        """SHA-256 chain over block token bytes: collision-proof keys, O(L)."""
+    def _chain_digests(self, prompt_ids: list[int], n_blocks: int,
+                       tenant: str) -> list[bytes]:
+        """SHA-256 chain over block token bytes, seeded by the tenant's
+        namespace digest: collision-proof AND tenant-disjoint keys, O(L)."""
         bs = self.allocator.block_size
         digests = []
-        h = b""
+        h = tenant_seed(tenant)
         for k in range(n_blocks):
             block = np.asarray(prompt_ids[k * bs:(k + 1) * bs], np.int64)
             h = hashlib.sha256(h + block.tobytes()).digest()
@@ -211,19 +230,25 @@ class PrefixCache:
     def _shareable_blocks(self, prompt_ids: list[int]) -> int:
         return shareable_blocks(len(prompt_ids), self.allocator.block_size)
 
-    def digest_chain(self, prompt_ids: list[int], n_blocks: int) -> list[bytes]:
+    def digest_chain(self, prompt_ids: list[int], n_blocks: int, *,
+                     tenant: str) -> list[bytes]:
         """Public digest access: the host spill tier (serving/kv_tier.py)
         and the fleet migration path key their entries by the SAME chain
         digests lookup walks, so a demoted or migrated prefix is found by
-        the identical probe that would have hit it on-device."""
-        return self._chain_digests(prompt_ids, n_blocks)
+        the identical probe that would have hit it on-device.  ``tenant``
+        is keyword-required on purpose: every key derivation must name its
+        namespace (graftcheck's ``tenant-namespace`` rule enforces it)."""
+        return self._chain_digests(prompt_ids, n_blocks, tenant)
 
     def _touch(self, key: bytes, entry: _PrefixEntry) -> None:
         del self._entries[key]
         self._entries[key] = entry
 
-    def lookup(self, prompt_ids: list[int]) -> tuple[list[int], int]:
-        """Longest cached prefix of ``prompt_ids``.
+    def lookup(self, prompt_ids: list[int], *,
+               tenant: str) -> tuple[list[int], int]:
+        """Longest cached prefix of ``prompt_ids`` in ``tenant``'s
+        namespace (digests of other tenants can never match: the chains
+        are seeded differently).
 
         Returns (shared block ids increfed for the caller, tokens covered).
         The caller owns one reference per returned block and must release
@@ -232,7 +257,7 @@ class PrefixCache:
         n = self._shareable_blocks(prompt_ids)
         if n <= 0 or not self._entries:
             return [], 0
-        digests = self._chain_digests(prompt_ids, n)
+        digests = self._chain_digests(prompt_ids, n, tenant)
         for k in range(n, 0, -1):
             entry = self._entries.get(digests[k - 1])
             if entry is not None and len(entry.blocks) >= k:
@@ -242,7 +267,8 @@ class PrefixCache:
                 return shared, k * self.allocator.block_size
         return [], 0
 
-    def register(self, prompt_ids: list[int], blocks: list[int]) -> None:
+    def register(self, prompt_ids: list[int], blocks: list[int], *,
+                 tenant: str) -> None:
         """Publish a prompt's full blocks for reuse (after its prefill has
         been dispatched — page contents are ordered by device data flow).
 
@@ -253,7 +279,7 @@ class PrefixCache:
         n = self._shareable_blocks(prompt_ids)
         if n <= 0:
             return
-        digests = self._chain_digests(prompt_ids, n)
+        digests = self._chain_digests(prompt_ids, n, tenant)
         for k in range(n, 0, -1):
             key = digests[k - 1]
             entry = self._entries.get(key)
@@ -265,7 +291,13 @@ class PrefixCache:
                     return
             shared = blocks[:k]
             self.allocator.incref(shared)
-            self._entries[key] = _PrefixEntry(tuple(shared))
+            self._entries[key] = _PrefixEntry(tuple(shared), tenant)
+        # Fairness cap: if this registration pushed the tenant over its
+        # share (and someone else is resident), the tenant pays with its
+        # OWN oldest entries — never another tenant's.
+        while self._overshare_tenant() == tenant:
+            if not self._evict_key(self._tenant_lru_key(tenant)):
+                break
 
     def evictable_blocks(self) -> int:
         """Blocks an eviction sweep could return to the free list right
@@ -283,26 +315,83 @@ class PrefixCache:
         return sum(1 for b, n in coverage.items()
                    if self.allocator.ref_count(b) == n)
 
-    def peek_lru(self) -> tuple[bytes, list[int]] | None:
-        """The LRU entry's (chain digest, block ids) without evicting or
-        touching refcounts — the engine's host-spill wrapper reads the
-        victim's pages off-device *before* calling ``evict_lru`` so a
-        pressured eviction demotes to the host tier instead of dropping."""
+    def blocks_by_tenant(self) -> dict[str, int]:
+        """Distinct resident blocks per tenant (tenant namespaces are
+        disjoint, so the counts never double-book a block) — the fairness
+        accounting behind the max-share cap and ``tenant_kv_blocks``."""
+        per: dict[str, set[int]] = {}
+        for entry in self._entries.values():
+            per.setdefault(entry.tenant, set()).update(entry.blocks)
+        return {t: len(s) for t, s in per.items()}
+
+    def _overshare_tenant(self) -> str | None:
+        """The tenant currently over its max-share cap (worst offender),
+        or None.  Only meaningful with >= 2 resident tenants: a sole
+        tenant using the whole cache victimizes nobody."""
+        if self.max_tenant_share >= 1.0:
+            return None
+        per = self.blocks_by_tenant()
+        if len(per) < 2:
+            return None
+        total = sum(per.values())
+        if total <= 0:
+            return None
+        worst = max(per, key=lambda t: per[t])
+        if per[worst] > self.max_tenant_share * total:
+            return worst
+        return None
+
+    def _tenant_lru_key(self, tenant: str) -> bytes | None:
+        """The oldest entry belonging to ``tenant`` (insertion order)."""
+        for key, entry in self._entries.items():
+            if entry.tenant == tenant:
+                return key
+        return None
+
+    def _victim_key(self) -> bytes | None:
+        """The entry the next eviction should take: an over-share tenant's
+        own LRU when the fairness cap is tripped, the global LRU otherwise.
+        ``peek_lru`` and ``evict_lru`` both route through this so the
+        engine's spill-then-evict sequence stays coherent."""
         if not self._entries:
             return None
-        key = next(iter(self._entries))
-        return key, list(self._entries[key].blocks)
+        offender = self._overshare_tenant()
+        if offender is not None:
+            key = self._tenant_lru_key(offender)
+            if key is not None:
+                return key
+        return next(iter(self._entries))
 
-    def evict_lru(self) -> bool:
-        """Drop the least-recently-used entry (releasing the cache's block
-        references).  Returns False when the cache is empty."""
-        if not self._entries:
+    def _evict_key(self, key: bytes | None) -> bool:
+        if key is None:
             return False
-        key = next(iter(self._entries))
         entry = self._entries.pop(key)
         self.allocator.free(list(entry.blocks))
         self.evictions += 1
         return True
+
+    def peek_lru(self) -> tuple[bytes, list[int]] | None:
+        """The next eviction victim's (chain digest, block ids) without
+        evicting or touching refcounts — the engine's host-spill wrapper
+        reads the victim's pages off-device *before* calling ``evict_lru``
+        so a pressured eviction demotes to the host tier instead of
+        dropping."""
+        key = self._victim_key()
+        if key is None:
+            return None
+        return key, list(self._entries[key].blocks)
+
+    def peek_lru_tenant(self) -> str | None:
+        """Namespace owner of the next eviction victim (the spill wrapper
+        tags the host-tier entry with it)."""
+        key = self._victim_key()
+        return self._entries[key].tenant if key is not None else None
+
+    def evict_lru(self) -> bool:
+        """Drop the next victim entry (the over-share tenant's LRU when the
+        fairness cap is tripped, else the global LRU), releasing the
+        cache's block references.  Returns False when the cache is empty."""
+        return self._evict_key(self._victim_key())
 
     def clear(self) -> None:
         while self.evict_lru():

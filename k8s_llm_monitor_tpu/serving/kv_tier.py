@@ -54,6 +54,7 @@ from typing import Iterable
 import numpy as np
 
 from k8s_llm_monitor_tpu.devtools.lockcheck import guarded_by, make_lock
+from k8s_llm_monitor_tpu.resilience.tenancy import DEFAULT_TENANT
 
 logger = logging.getLogger("serving.kv_tier")
 
@@ -154,6 +155,10 @@ class SpilledPrefix:
     n_blocks: int
     layers: list[tuple[np.ndarray, ...]]
     nbytes: int = 0
+    #: Namespace owner.  The digest key is already tenant-seeded (the
+    #: chain seed is ``tenant_seed(tenant)``), so cross-tenant probes
+    #: cannot match; the tag exists for fairness accounting + stats.
+    tenant: str = DEFAULT_TENANT
 
     def __post_init__(self) -> None:
         if not self.nbytes:
@@ -161,25 +166,33 @@ class SpilledPrefix:
                 a.nbytes for leaf in self.layers for a in leaf)
 
 
-@guarded_by("_lock", "spills", "restores", "lost", "_bytes")
+@guarded_by("_lock", "spills", "restores", "lost", "_bytes",
+            "_tenant_bytes")
 class HostKVTier:
     """Byte-capped LRU of :class:`SpilledPrefix` entries, keyed by the
     prefix cache's chain digest (so a restore probe is the same digest
-    walk a device-tier lookup already does).
+    walk a device-tier lookup already does).  Digests are tenant-seeded
+    upstream, so the key space is already namespaced; the tier adds
+    per-tenant byte accounting and a max-share cap (``max_tenant_share``
+    of ``max_bytes``, enforced only while >= 2 tenants are resident) so
+    one tenant cannot monopolize host RAM either.
 
     Thread-safe: spill/restore run on the engine step thread, but stats
     are scraped from exporter threads and the supervisor constructs/
     keeps the tier across engine rebuilds.
     """
 
-    def __init__(self, max_bytes: int = 256 << 20):
+    def __init__(self, max_bytes: int = 256 << 20,
+                 max_tenant_share: float = 1.0):
         self.max_bytes = max_bytes
+        self.max_tenant_share = float(max_tenant_share)
         self._entries: dict[bytes, SpilledPrefix] = {}
         self.spills = 0
         self.restores = 0
         #: Entries dropped without restore (host-cap eviction / clear).
         self.lost = 0
         self._bytes = 0
+        self._tenant_bytes: dict[str, int] = {}
         # Created last so __init__ writes above stay lockcheck-exempt.
         self._lock = make_lock("host_kv_tier")
 
@@ -192,23 +205,55 @@ class HostKVTier:
         with self._lock:
             return self._bytes
 
-    def put(self, digest: bytes, entry: SpilledPrefix) -> bool:
-        """Admit a demoted entry; returns False when it can never fit
-        (bigger than the whole cap) — the caller then just drops it."""
+    def _drop_locked(self, key: bytes, *, lost: bool) -> SpilledPrefix:
+        entry = self._entries.pop(key)
+        self._bytes -= entry.nbytes
+        rem = self._tenant_bytes.get(entry.tenant, 0) - entry.nbytes
+        if rem > 0:
+            self._tenant_bytes[entry.tenant] = rem
+        else:
+            self._tenant_bytes.pop(entry.tenant, None)
+        if lost:
+            self.lost += 1
+        return entry
+
+    def _tenant_lru_locked(self, tenant: str,
+                           skip: bytes | None = None) -> bytes | None:
+        for key, entry in self._entries.items():
+            if entry.tenant == tenant and key != skip:
+                return key
+        return None
+
+    def put(self, digest: bytes, entry: SpilledPrefix, *,
+            tenant: str = DEFAULT_TENANT) -> bool:
+        """Admit a demoted entry under ``tenant``'s namespace; returns
+        False when it can never fit (bigger than the whole cap) — the
+        caller then just drops it."""
+        entry.tenant = tenant
         if entry.nbytes > self.max_bytes:
             return False
         with self._lock:
-            old = self._entries.pop(digest, None)
-            if old is not None:
-                self._bytes -= old.nbytes
+            if digest in self._entries:
+                self._drop_locked(digest, lost=False)
             while self._bytes + entry.nbytes > self.max_bytes:
-                victim_key = next(iter(self._entries))
-                victim = self._entries.pop(victim_key)
-                self._bytes -= victim.nbytes
-                self.lost += 1
+                self._drop_locked(next(iter(self._entries)), lost=True)
             self._entries[digest] = entry
             self._bytes += entry.nbytes
+            self._tenant_bytes[tenant] = (
+                self._tenant_bytes.get(tenant, 0) + entry.nbytes)
             self.spills += 1
+            # Fairness cap: a tenant over its byte share (with another
+            # tenant resident) pays with its OWN oldest entries.  The
+            # just-admitted entry is never the victim, so spill always
+            # makes progress.
+            if self.max_tenant_share < 1.0:
+                cap = self.max_tenant_share * self.max_bytes
+                while (len(self._tenant_bytes) >= 2
+                       and self._tenant_bytes.get(tenant, 0) > cap):
+                    victim = self._tenant_lru_locked(tenant, skip=digest)
+                    if victim is None:
+                        break
+                    self._drop_locked(victim, lost=True)
             return True
 
     def take(self, digest: bytes) -> SpilledPrefix | None:
@@ -216,10 +261,9 @@ class HostKVTier:
         the host copy — the device tier re-registers it on rehydrate,
         so keeping a stale duplicate would only burn host RAM)."""
         with self._lock:
-            entry = self._entries.pop(digest, None)
-            if entry is None:
+            if digest not in self._entries:
                 return None
-            self._bytes -= entry.nbytes
+            entry = self._drop_locked(digest, lost=False)
             self.restores += 1
             return entry
 
@@ -239,6 +283,12 @@ class HostKVTier:
             self.lost += len(self._entries)
             self._entries.clear()
             self._bytes = 0
+            self._tenant_bytes.clear()
+
+    def bytes_by_tenant(self) -> dict[str, int]:
+        """Resident host-tier bytes per tenant (fairness accounting)."""
+        with self._lock:
+            return dict(self._tenant_bytes)
 
     def stats(self) -> dict:
         with self._lock:
@@ -249,4 +299,5 @@ class HostKVTier:
                 "spills": self.spills,
                 "restores": self.restores,
                 "lost": self.lost,
+                "tenant_bytes": dict(self._tenant_bytes),
             }

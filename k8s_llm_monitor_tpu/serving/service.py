@@ -36,6 +36,11 @@ from k8s_llm_monitor_tpu.resilience.slo import (
     BrownoutController,
     normalize_slo_class,
 )
+from k8s_llm_monitor_tpu.resilience.tenancy import (
+    DEFAULT_TENANT,
+    TenantGovernor,
+    normalize_tenant,
+)
 from k8s_llm_monitor_tpu.serving.engine import (
     GenerationRequest,
     GenerationResult,
@@ -158,8 +163,15 @@ class EngineService:
     def __init__(self, engine: InferenceEngine,
                  health: HealthMonitor | None = None,
                  on_death: Callable[[str], None] | None = None,
-                 brownout: BrownoutController | None = None):
+                 brownout: BrownoutController | None = None,
+                 governor: TenantGovernor | None = None):
         self.engine = engine
+        # Per-tenant admission + quota accountant (resilience/tenancy.py).
+        # Owned by the supervisor on single-replica roles so reservations
+        # survive engine rebuilds; replicas behind a FleetRouter get None —
+        # the router charges once per logical request, and a replica-level
+        # governor would double-charge hedges and failover replays.
+        self.governor = governor
         engine.token_sink = self._sink
         # One health monitor per service: the engine reports dispatch
         # failures / watchdog trips into it, submit() reports shed/admit,
@@ -219,7 +231,7 @@ class EngineService:
 
     def _record_shed(self, slo_class: str = DEFAULT_CLASS,
                      request_id: str = "", reason: str = "",
-                     trace_ctx=None) -> float:
+                     trace_ctx=None, tenant: str = "") -> float:
         """Bump shed counters; returns a Retry-After hint that backs off
         with consecutive sheds *of this class* (reset by the class's next
         successful admit) — overloaded batch lanes escalate their hint
@@ -234,14 +246,16 @@ class EngineService:
                 self._shed_streaks.get(slo_class, 0) + 1)
             streak = self._shed_streaks[slo_class]
         self.health.record_shed()
+        if tenant and self.governor is not None:
+            self.governor.note_shed(tenant)
         now = time.monotonic()
         get_tracer().record(
             "service.shed", now, now, trace_ctx, status="error",
             attrs={"request_id": request_id, "class": slo_class,
-                   "reason": reason})
+                   "reason": reason, "tenant": tenant})
         get_flight_recorder().note(
             "shed", request_id=request_id, slo_class=slo_class,
-            reason=reason)
+            reason=reason, tenant=tenant)
         return self._shed_backoff.delay(min(streak - 1, 4))
 
     def submit(
@@ -253,17 +267,25 @@ class EngineService:
         force: bool = False,
         handle: RequestHandle | None = None,
         slo_class: str = DEFAULT_CLASS,
+        tenant: str = DEFAULT_TENANT,
     ) -> RequestHandle:
         """Admit a generation request.
 
-        ``force`` bypasses drain/shed checks (supervisor replay: the
-        request was already accepted once and must not be refused on its
-        way back in).  ``handle`` re-installs an existing RequestHandle
-        under the same request id so a replayed request keeps streaming to
-        the original caller with no token gap.  ``slo_class`` orders
-        admission, shedding, and eviction (resilience/slo.py).
+        ``force`` bypasses drain/shed/quota checks (supervisor replay: the
+        request was already accepted once and must not be refused — or
+        re-charged — on its way back in).  ``handle`` re-installs an
+        existing RequestHandle under the same request id so a replayed
+        request keeps streaming to the original caller with no token gap.
+        ``slo_class`` orders admission, shedding, and eviction
+        (resilience/slo.py); ``tenant`` is the quota/namespace owner
+        (resilience/tenancy.py) — quota refusals raise a tenant-tagged
+        OverloadedError *before* the SLO shed check, so an over-quota
+        tenant's traffic never reaches the queue and cannot push a
+        within-quota tenant into shedding.
         """
         slo_class = normalize_slo_class(slo_class)
+        tenant = normalize_tenant(tenant)
+        sampling = sampling or SamplingParams()
         # The id exists BEFORE any shed decision so every 429/503 body
         # carries it — a refused request is joinable with traces and
         # journal records even though it never reached the engine.
@@ -287,26 +309,45 @@ class EngineService:
                 # Not retriable *here* — this replica is going away; the
                 # client should retry against another replica.
                 hint = self._record_shed(slo_class, request_id, "draining",
-                                         trace_ctx)
+                                         trace_ctx, tenant)
                 raise OverloadedError("draining", retriable=False,
                                       retry_after_s=hint,
                                       slo_class=slo_class,
-                                      request_id=request_id)
+                                      request_id=request_id,
+                                      tenant=tenant)
+            # Quota gate FIRST: over-quota work is refused before it can
+            # occupy queue slots that would push should_shed() into
+            # refusing a within-quota tenant.  Raises a tenant-tagged
+            # OverloadedError (HTTP 429 + Retry-After) and reserves
+            # max_tokens on success.
+            if self.governor is not None:
+                self.governor.admit(
+                    tenant, request_id,
+                    max_tokens=sampling.max_tokens,
+                    prompt_bytes=len(prompt_ids) * 4,
+                    slo_class=slo_class)
             # Prompt + first sampled token is the KV footprint admission
             # must eventually place (engine._admit_round allocates L+1) —
             # the tier-aware capacity clause checks it against headroom.
             reason = self.engine.should_shed(
                 slo_class, need_tokens=len(prompt_ids) + 1)
             if reason:
+                if self.governor is not None:
+                    # SLO shed after a successful quota reservation:
+                    # release the token reservation (nothing was
+                    # generated) but keep the request-rate charge — a
+                    # shed retry storm still counts against the tenant.
+                    self.governor.settle(request_id)
                 hint = self._record_shed(slo_class, request_id, reason,
-                                         trace_ctx)
+                                         trace_ctx, tenant)
                 raise OverloadedError(
                     reason,
                     queue_depth=self.engine.queue_depth,
                     queue_tokens=self.engine.queue_tokens,
                     retry_after_s=hint,
                     slo_class=slo_class,
-                    request_id=request_id)
+                    request_id=request_id,
+                    tenant=tenant)
         self.health.record_admit()
         with self._handles_lock:
             self._shed_streaks.pop(slo_class, None)
@@ -325,9 +366,10 @@ class EngineService:
         self._submissions.put(GenerationRequest(
             request_id=request_id,
             prompt_ids=list(prompt_ids),
-            sampling=sampling or SamplingParams(),
+            sampling=sampling,
             deadline_s=deadline_s,
             slo_class=slo_class,
+            tenant=tenant,
             trace=trace_ctx,
         ))
         self._wake.set()
@@ -441,6 +483,10 @@ class EngineService:
             request_id=request_id, token_ids=[], finish_reason="error",
             ttft_s=0.0, latency_s=0.0, error=msg,
         )
+        if self.governor is not None:
+            # Failed before/without generating: settle refunds whatever
+            # the reservation still holds beyond tokens already streamed.
+            self.governor.settle(request_id)
         # Terminal outcome: the observer (journal) must tombstone it so a
         # restart doesn't resurrect an invalid/cancelled request.
         if self.observer is not None:
@@ -551,6 +597,10 @@ class EngineService:
             self._handles.clear()
         now = time.monotonic()
         for h in handles:
+            if self.governor is not None:
+                # Terminal failure (no supervisor to replay): settle so
+                # the tenant is only charged for tokens actually streamed.
+                self.governor.settle(h.request_id)
             # The engine died before retiring this request, so its
             # "engine.request" span (the parent of any phase spans already
             # recorded) would never be emitted — close it here so the
@@ -578,6 +628,14 @@ class EngineService:
                 self.observer(request_id, toks, result)
             except Exception:  # noqa: BLE001 — observer must not kill the loop
                 logger.exception("observer failed for %s", request_id)
+        # Quota accounting mirrors the journal's view: tokens are charged
+        # as emitted (delivered once, here) and the reservation settles on
+        # the terminal result — refunding reserved-but-ungenerated tokens.
+        if self.governor is not None:
+            if toks:
+                self.governor.note_delivered(request_id, len(toks))
+            if result is not None:
+                self.governor.settle(request_id)
         with self._handles_lock:
             handle = self._handles.get(request_id)
             if result is not None:

@@ -58,6 +58,11 @@ from k8s_llm_monitor_tpu.resilience.journal import (
 )
 from k8s_llm_monitor_tpu.resilience.retry import Backoff
 from k8s_llm_monitor_tpu.resilience.slo import DEFAULT_CLASS
+from k8s_llm_monitor_tpu.resilience.tenancy import (
+    DEFAULT_TENANT,
+    TenantGovernor,
+    normalize_tenant,
+)
 from k8s_llm_monitor_tpu.serving.engine import (
     GenerationResult,
     InferenceEngine,
@@ -86,6 +91,7 @@ class _Tracked:
     emitted: list[int] = field(default_factory=list)
     handle: Optional[RequestHandle] = None
     slo_class: str = DEFAULT_CLASS
+    tenant: str = DEFAULT_TENANT
 
 
 def _sampling_from_dict(data: dict) -> SamplingParams:
@@ -124,10 +130,15 @@ class EngineSupervisor:
         heartbeat_timeout_s: float = 30.0,
         poll_interval_s: float = 0.1,
         clock=time.monotonic,
+        governor: TenantGovernor | None = None,
     ):
         self.engine_factory = engine_factory
         self.journal = journal
         self.health = health or HealthMonitor()
+        # Supervisor-owned so per-tenant reservations survive engine
+        # rebuilds (the replacement EngineService gets the same instance)
+        # and warm starts can restore quota state from the journal.
+        self.governor = governor
         self.max_restarts = max_restarts
         self.backoff = backoff or Backoff(base_s=0.2, cap_s=5.0, jitter=0.0)
         self.heartbeat_timeout_s = heartbeat_timeout_s
@@ -186,7 +197,8 @@ class EngineSupervisor:
     def _build_service(self) -> EngineService:
         engine = self.engine_factory()
         svc = EngineService(engine, health=self.health,
-                            on_death=self._on_service_death)
+                            on_death=self._on_service_death,
+                            governor=self.governor)
         svc.observer = self._observe
         return svc
 
@@ -204,8 +216,12 @@ class EngineSupervisor:
         request_id: str | None = None,
         deadline_s: float = 0.0,
         slo_class: str = DEFAULT_CLASS,
+        tenant: str = DEFAULT_TENANT,
     ) -> RequestHandle:
         """Journal (write-ahead), track, and admit one request."""
+        # Normalized HERE so the journal never records a raw tenant string
+        # (replay re-derives quota state from what the WAL says).
+        tenant = normalize_tenant(tenant)
         if request_id is None:
             # Unique across process restarts sharing one journal dir.
             # Assigned BEFORE any refusal so every 429/503 body carries
@@ -217,14 +233,15 @@ class EngineSupervisor:
             raise OverloadedError(
                 "engine rebuilding", retriable=True,
                 retry_after_s=self.backoff.delay(0) + 0.5,
-                slo_class=slo_class, request_id=request_id)
+                slo_class=slo_class, request_id=request_id,
+                tenant=tenant)
         if state != SERVING:
             raise OverloadedError(f"lifecycle state {state}",
                                   retriable=False, slo_class=slo_class,
-                                  request_id=request_id)
+                                  request_id=request_id, tenant=tenant)
         sampling = sampling or SamplingParams()
         tracked = _Tracked(list(prompt_ids), sampling, deadline_s,
-                           time.time(), slo_class=slo_class)
+                           time.time(), slo_class=slo_class, tenant=tenant)
         # Track before the engine can emit a single token for this id, and
         # journal before the engine can accept it (write-AHEAD).
         with self._lock:
@@ -232,11 +249,11 @@ class EngineSupervisor:
         if self.journal is not None:
             self.journal.log_admit(request_id, prompt_ids, sampling,
                                    deadline_s, tracked.arrival_unix,
-                                   slo_class=slo_class)
+                                   slo_class=slo_class, tenant=tenant)
         try:
             handle = self.service.submit(
                 prompt_ids, sampling, request_id=request_id,
-                deadline_s=deadline_s, slo_class=slo_class)
+                deadline_s=deadline_s, slo_class=slo_class, tenant=tenant)
         except BaseException as exc:
             # Refused (shed/dead): untrack and tombstone the admit record.
             with self._lock:
@@ -423,7 +440,7 @@ class EngineSupervisor:
             tracked.handle = self.service.submit(
                 tracked.prompt_ids + emitted, sampling, request_id=rid,
                 deadline_s=deadline_s, force=True, handle=tracked.handle,
-                slo_class=tracked.slo_class)
+                slo_class=tracked.slo_class, tenant=tracked.tenant)
         except Exception as exc:  # noqa: BLE001 — replay refusal is terminal
             self._finish_tracked(rid, tracked, GenerationResult(
                 request_id=rid, token_ids=emitted, finish_reason="error",
@@ -436,6 +453,11 @@ class EngineSupervisor:
                         result: GenerationResult) -> None:
         with self._lock:
             self._tracked.pop(rid, None)
+        if self.governor is not None:
+            # Settle is idempotent; this covers terminal paths that never
+            # re-reach the service (budget-done, deadline, replay refusal)
+            # so the tenant is charged only for tokens actually emitted.
+            self.governor.settle(rid)
         if self.journal is not None:
             self.journal.log_complete(rid)
         if tracked.handle is not None:
@@ -471,9 +493,22 @@ class EngineSupervisor:
                 arrival_unix=rec.arrival_unix or time.time(),
                 emitted=list(rec.emitted),
                 slo_class=rec.slo_class,
+                tenant=rec.tenant,
             )
             with self._lock:
                 self._tracked[rec.request_id] = tracked
+            if self.governor is not None:
+                # Rebuild the tenant's reservation exactly as the WAL
+                # recorded it: tokens already streamed are pre-charged
+                # (force-taken, possibly into debt) so the eventual
+                # settle charges emitted tokens once — a crash can never
+                # launder quota, and a torn tail for one tenant cannot
+                # perturb another tenant's accounting (records are
+                # per-request and tenant-tagged).
+                self.governor.restore(
+                    rec.request_id, rec.tenant,
+                    max_tokens=tracked.sampling.max_tokens,
+                    delivered=len(rec.emitted))
             if self._replay_one(rec.request_id, tracked):
                 replayed += 1
         with self._lock:

@@ -121,7 +121,7 @@ class RoleReplica(Replica):
         return self._draining
 
     def generate(self, prompt_ids, sampling=None, request_id=None,
-                 deadline_s=0.0, slo_class="standard"):
+                 deadline_s=0.0, slo_class="standard", tenant="public"):
         if self.refuse_generate or (self.refuse_after is not None
                                     and len(self.calls) >= self.refuse_after):
             raise ReplicaUnavailable(f"{self.replica_id}: refusing")
@@ -137,13 +137,13 @@ class RoleReplica(Replica):
             finish_reason="length", ttft_s=0.0, latency_s=0.0))
         return h
 
-    def fetch_prefix(self, token_ids):
+    def fetch_prefix(self, token_ids, tenant="public"):
         self.fetches.append(list(token_ids))
         if self.fetch_exc is not None:
             raise self.fetch_exc
         return self.blob
 
-    def install_prefix(self, blob):
+    def install_prefix(self, blob, tenant="public"):
         self.installs.append(blob)
         if self.install_exc is not None:
             raise self.install_exc
@@ -357,11 +357,11 @@ def test_remove_gc_forgets_breaker_inflight_and_prefixes():
     for _ in range(6):
         prompt = list(rng.integers(3, 300, size=6))
         router.submit(prompt, SamplingParams(max_tokens=2)).result(timeout=10)
-    assert any(rid == "a" for _, rid in router._recent_prefixes.values())
+    assert any(rid == "a" for _, rid, _t in router._recent_prefixes.values())
     reg.remove("a")
     assert "a" not in reg.snapshot()
     assert reg.get("a") is None  # breaker + inflight died with the entry
-    assert all(rid != "a" for _, rid in router._recent_prefixes.values()), \
+    assert all(rid != "a" for _, rid, _t in router._recent_prefixes.values()), \
         "removed replica still owns prefix-memory entries"
 
 

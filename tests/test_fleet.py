@@ -107,7 +107,7 @@ class ScriptedReplica(Replica):
         return ReplicaStats(total_slots=4)
 
     def generate(self, prompt_ids, sampling=None, request_id=None,
-                 deadline_s=0.0, slo_class="standard"):
+                 deadline_s=0.0, slo_class="standard", tenant="public"):
         sampling = sampling or SamplingParams()
         self.calls.append((list(prompt_ids), sampling, request_id))
         h = RequestHandle(request_id or "r", eos_id=-1,
@@ -152,11 +152,12 @@ class ScriptedQueryReplica(Replica):
     def stats(self):
         return ReplicaStats(total_slots=4)
 
-    def query(self, question, slo_class="interactive"):
+    def query(self, question, slo_class="interactive", tenant="public"):
         self.queries.append(question)
         return {"status": "success", "served_by": self.replica_id}
 
-    def query_stream(self, question, slo_class="interactive"):
+    def query_stream(self, question, slo_class="interactive",
+                     tenant="public"):
         def chunks():
             for i, ch in enumerate(self.answer):
                 if (self.fail_stream_after is not None

@@ -354,6 +354,89 @@ def test_unconstrained_parse_sees_through_strip_chains():
     assert len(lint(src, "unconstrained-model-parse")) == 1
 
 
+# -- astlint: tenant-namespace -----------------------------------------------
+
+
+def test_tenant_namespace_flags_bare_prefix_cache_calls():
+    src = """
+    def admit(pc, prompt, blocks):
+        shared, toks = pc.lookup(prompt)
+        pc.register(prompt, blocks)
+        digests = self.prefix_cache.digest_chain(prompt, 3)
+    """
+    assert len(lint(src, "tenant-namespace")) == 3
+
+
+def test_tenant_namespace_flags_tier_put_and_blob_moves():
+    src = """
+    def spill(tier, digest, rows, owner, target, blob, prompt):
+        tier.put(digest, rows)
+        b = owner.fetch_prefix(prompt)
+        target.install_prefix(b)
+        e = owner.export_prefix(prompt)
+    """
+    assert len(lint(src, "tenant-namespace")) == 4
+
+
+def test_tenant_namespace_clean_with_tenant_kwargs():
+    src = """
+    def admit(pc, tier, owner, target, prompt, blocks, digest, rows, blob):
+        shared, toks = pc.lookup(prompt, tenant="a")
+        pc.register(prompt, blocks, tenant="a")
+        tier.put(digest, rows, tenant="a")
+        b = owner.fetch_prefix(prompt, tenant="a")
+        target.install_prefix(b, expected_tenant="a")
+        e = owner.export_prefix(prompt, tenant="a")
+        target.install_prefix(e, **kw)  # splat: assumed threaded
+    """
+    assert lint(src, "tenant-namespace") == []
+
+
+def test_tenant_namespace_ignores_unrelated_receivers():
+    src = """
+    import atexit
+
+    def other(tracer, registry, q):
+        trace = tracer.lookup(q)           # not a prefix cache
+        atexit.register(close)             # not a prefix cache
+        registry.put("k", 1)               # not a KV tier
+    """
+    assert lint(src, "tenant-namespace") == []
+
+
+def test_tenant_namespace_exempts_defining_modules():
+    src = """
+    def digest_chain(self, prompt):
+        return self._cache.lookup(prompt)
+    """
+    import textwrap
+
+    from k8s_llm_monitor_tpu.devtools.astlint import lint_source
+    findings = lint_source(textwrap.dedent(src),
+                           path="k8s_llm_monitor_tpu/serving/kv_cache.py")
+    assert [f for f in findings if f.rule == "tenant-namespace"] == []
+
+
+def test_tenant_namespace_live_repo_clean_without_suppressions():
+    """The privacy invariant's second enforcement layer: every prefix-KV
+    call site in the live tree threads the tenant, and none of them hides
+    behind a suppression comment."""
+    import pathlib
+
+    root = pathlib.Path(astlint.__file__).resolve().parents[2]
+    rule = astlint.TenantNamespaceRule()
+    offenders = []
+    for sub in ("k8s_llm_monitor_tpu", "tests", "bench.py"):
+        for p in astlint.iter_py_files(root / sub):
+            src = p.read_text(encoding="utf-8")
+            per_line, per_file = astlint._suppressions(src)
+            suppressed = per_file | set().union(*per_line.values(), set())
+            assert rule.name not in suppressed, \
+                f"{p}: {rule.name} suppression is not allowed"
+            offenders += astlint.lint_source(src, str(p), rules=[rule])
+    assert offenders == [], [f.human() for f in offenders]
+
+
 # -- astlint: suppressions + parse errors ------------------------------------
 
 

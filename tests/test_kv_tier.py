@@ -19,6 +19,7 @@ from k8s_llm_monitor_tpu.models import llama
 from k8s_llm_monitor_tpu.models.config import ModelConfig
 from k8s_llm_monitor_tpu.resilience.faults import get_injector
 from k8s_llm_monitor_tpu.resilience.retry import Backoff
+from k8s_llm_monitor_tpu.resilience.tenancy import DEFAULT_TENANT as TEN
 from k8s_llm_monitor_tpu.serving.engine import (
     EngineConfig,
     InferenceEngine,
@@ -148,12 +149,12 @@ def test_host_tier_lru_byte_cap_and_counters():
             n_blocks=1, layers=[(np.zeros(nbytes, np.uint8),)])
 
     tier = HostKVTier(max_bytes=100)
-    assert not tier.put(b"huge", entry(101))       # can never fit
-    assert tier.put(b"a", entry(40))
-    assert tier.put(b"b", entry(40))
+    assert not tier.put(b"huge", entry(101), tenant=TEN)  # can never fit
+    assert tier.put(b"a", entry(40), tenant=TEN)
+    assert tier.put(b"b", entry(40), tenant=TEN)
     assert len(tier) == 2 and tier.bytes_used == 80
     # Third 40-byte entry displaces the LRU ("a") and counts it lost.
-    assert tier.put(b"c", entry(40))
+    assert tier.put(b"c", entry(40), tenant=TEN)
     assert tier.contains(b"b") and not tier.contains(b"a")
     assert tier.stats()["lost"] == 1
 
@@ -167,12 +168,37 @@ def test_host_tier_lru_byte_cap_and_counters():
     assert tier.stats()["lost"] == 2               # "c" dropped unrestored
 
 
+def test_host_tier_tenant_share_cap_and_byte_accounting():
+    """Eviction fairness at the host tier: a tenant over its byte share
+    (while another tenant is resident) evicts its OWN oldest entries —
+    a flooding tenant can't push a quiet tenant's spills out of RAM."""
+    def entry(nbytes):
+        return SpilledPrefix(
+            n_blocks=1, layers=[(np.zeros(nbytes, np.uint8),)])
+
+    tier = HostKVTier(max_bytes=100, max_tenant_share=0.5)
+    assert tier.put(b"a1", entry(30), tenant="team-a")
+    assert tier.put(b"b1", entry(30), tenant="team-b")
+    # team-a exceeds its 50-byte share with team-b resident: its own LRU
+    # ("a1") pays; team-b's entry is untouched.
+    assert tier.put(b"a2", entry(30), tenant="team-a")
+    per = tier.bytes_by_tenant()
+    assert per["team-a"] <= 50 and per["team-b"] == 30
+    assert not tier.contains(b"a1") and tier.contains(b"b1")
+    assert tier.contains(b"a2")                    # new entry never victim
+    # Alone in the tier, the cap does not bind (no one to be unfair to).
+    tier2 = HostKVTier(max_bytes=100, max_tenant_share=0.5)
+    assert tier2.put(b"x1", entry(40), tenant="team-a")
+    assert tier2.put(b"x2", entry(40), tenant="team-a")
+    assert tier2.bytes_by_tenant()["team-a"] == 80
+
+
 def test_peek_lru_does_not_evict_or_touch_refcounts():
     a = BlockAllocator(num_blocks=32, block_size=4)
     pc = PrefixCache(a, max_entries=8)
     prompt = list(range(100, 109))                 # 2 full blocks
     blocks = a.alloc(10)
-    pc.register(prompt, blocks)
+    pc.register(prompt, blocks, tenant=TEN)
     refs = [a.ref_count(b) for b in blocks[:2]]
     peek = pc.peek_lru()
     assert peek is not None
@@ -327,12 +353,12 @@ def test_export_install_byte_exact(params, kv_dtype):
     prompt = list(rng.integers(3, 300, size=24))
     r_src = src.generate([list(prompt)], SamplingParams(max_tokens=5))[0]
 
-    assert dst.export_prefix(list(prompt)) is None     # cold: nothing cached
-    blob = src.export_prefix(list(prompt))
+    assert dst.export_prefix(list(prompt), tenant=TEN) is None  # cold cache
+    blob = src.export_prefix(list(prompt), tenant=TEN)
     assert blob is not None and blob[:4] == b"KVX1"
 
-    assert dst.install_prefix(blob) == "installed"
-    assert dst.install_prefix(blob) == "cached"        # idempotent
+    assert dst.install_prefix(blob, expected_tenant=TEN) == "installed"
+    assert dst.install_prefix(blob, expected_tenant=TEN) == "cached"
 
     hits0 = dst.prefix_cache.hits
     r_dst = dst.generate([list(prompt)], SamplingParams(max_tokens=5))[0]
@@ -345,11 +371,12 @@ def test_export_install_byte_exact(params, kv_dtype):
     bad_meta = dict(meta, block_size=4)
     tampered = pack_prefix_blob(
         bad_meta, [np.frombuffer(b, np.uint8) for b in raw])
-    assert dst.install_prefix(tampered) == "incompatible"
+    assert dst.install_prefix(tampered,
+                              expected_tenant=TEN) == "incompatible"
 
     # Torn transfer: must raise, never partially install.
     with pytest.raises(BlobError):
-        dst.install_prefix(blob[:-7])
+        dst.install_prefix(blob[:-7], expected_tenant=TEN)
 
 
 @pytest.mark.slow

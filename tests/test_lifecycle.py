@@ -38,6 +38,7 @@ from k8s_llm_monitor_tpu.resilience.journal import (
     scan_journal,
 )
 from k8s_llm_monitor_tpu.resilience.retry import Backoff
+from k8s_llm_monitor_tpu.resilience.tenancy import TenantGovernor
 from k8s_llm_monitor_tpu.serving.engine import (
     EngineConfig,
     InferenceEngine,
@@ -410,6 +411,94 @@ def test_warm_start_replays_unsealed_journal(params, tmp_path):
     j3.close()
 
 
+# -- tenancy through the WAL --------------------------------------------------
+
+
+def test_journal_admit_records_carry_tenant(tmp_path):
+    j = RequestJournal(tmp_path, fsync="never")
+    j.log_admit("t1", [1, 2], {"max_tokens": 4}, slo_class="interactive",
+                tenant="team-a")
+    j.log_admit("t2", [3], {"max_tokens": 2})       # unlabeled request
+    j.close()
+    reqs, _ = scan_journal(tmp_path)
+    assert reqs["t1"].tenant == "team-a"
+    assert reqs["t1"].slo_class == "interactive"
+    assert reqs["t2"].tenant == "public"            # pre-tenancy default
+
+
+def test_torn_tail_never_corrupts_another_tenants_accounting(tmp_path):
+    """Tenant B's torn ADMIT vanishes without touching tenant A's
+    replayable state: WAL records are per-request and tenant-tagged, so
+    the scanner's drop-the-tail rule doubles as accounting isolation —
+    quota rebuilt from the scan charges A exactly its own emitted tokens
+    and B nothing, at every possible tear offset."""
+    recs = [
+        _pack(ADMIT, {"id": "a1", "prompt": [1, 2],
+                      "sampling": {"max_tokens": 6}, "deadline_s": 0.0,
+                      "arrival": 0.0, "tenant": "team-a"}),
+        _pack(PROGRESS, {"id": "a1", "tokens": [5, 6]}),
+        _pack(ADMIT, {"id": "b1", "prompt": [3, 4],
+                      "sampling": {"max_tokens": 9}, "deadline_s": 0.0,
+                      "arrival": 0.0, "tenant": "team-b"}),
+    ]
+    data = b"".join(recs)
+    base = len(data) - len(recs[-1])
+    seg = tmp_path / "wal-00000000.log"
+    for cut in range(base, len(data)):
+        seg.write_bytes(data[:cut])
+        reqs, _ = scan_journal(tmp_path)            # must not raise
+        assert "b1" not in reqs, f"torn admit resurrected at cut={cut}"
+        a1 = reqs["a1"]
+        assert a1.tenant == "team-a" and a1.emitted == [5, 6]
+        gov = TenantGovernor(tokens_per_s=0.001, token_burst=100.0,
+                             clock=lambda: 0.0)
+        for rec in reqs.values():
+            if not rec.completed:
+                gov.restore(rec.request_id, rec.tenant,
+                            max_tokens=int(rec.sampling.get("max_tokens", 0)),
+                            delivered=len(rec.emitted))
+        snap = gov.snapshot()
+        assert set(snap) == {"team-a"}
+        assert snap["team-a"]["inflight"] == 1
+        # 6-token budget, 2 already streamed: 4 remain reserved.
+        assert snap["team-a"]["quota_remaining"] == 96.0
+
+
+@pytest.mark.slow  # rebuilds an engine; covered by make chaos-tenant
+def test_warm_start_restores_per_tenant_quota(params, tmp_path):
+    """A supervisor warm start rebuilds per-tenant quota state from the
+    WAL: the incomplete request's remaining budget is re-reserved under
+    its recorded tenant, the replay streams the rest, and settlement
+    charges exactly the delivered tokens — a crash cannot launder quota."""
+    wal = tmp_path / "wal"
+    j = RequestJournal(wal, fsync="never")
+    j.log_admit("wa", [1, 2, 3], {"max_tokens": 5, "temperature": 0.0},
+                tenant="team-a")
+    j.log_progress("wa", [7, 8])                    # 2 of 5 streamed
+    j.log_admit("wb", [4, 5], {"max_tokens": 3}, tenant="team-b")
+    j.log_complete("wb")                            # nothing to replay
+    j.close()
+
+    gov = TenantGovernor(tokens_per_s=0.001, token_burst=100.0)
+    sup = _mk_supervisor(params, journal=RequestJournal(wal, fsync="never"),
+                         governor=gov)
+    try:
+        assert sup.replayed_total == 1
+        assert _wait(lambda: sup.snapshot()["tracked"] == 0, timeout=30.0)
+    finally:
+        sup.shutdown(grace_s=5.0)
+    snap = gov.snapshot()
+    assert set(snap) == {"team-a"}                  # completed b never restored
+    st = snap["team-a"]
+    # Replay regenerated the 3 remaining tokens; with the 2 pre-crash
+    # tokens the caller saw 5, and exactly 5 are charged.
+    assert st["charged_tokens"] == 5
+    assert st["inflight"] == 0
+    # The new process's bucket paid only for the replayed remainder (the
+    # pre-crash 2 were charged to the dead process's bucket).
+    assert 96.0 <= st["quota_remaining"] <= 98.0
+
+
 # -- SIGTERM graceful handover ------------------------------------------------
 
 
@@ -474,7 +563,7 @@ class _OverloadedAnalysis:
     def __init__(self, exc):
         self._exc = exc
 
-    def query(self, question, slo_class="interactive"):
+    def query(self, question, slo_class="interactive", tenant=""):
         raise self._exc
 
 

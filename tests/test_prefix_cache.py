@@ -20,6 +20,7 @@ from k8s_llm_monitor_tpu.serving.engine import (
     InferenceEngine,
     SamplingParams,
 )
+from k8s_llm_monitor_tpu.resilience.tenancy import DEFAULT_TENANT as TEN
 from k8s_llm_monitor_tpu.serving.kv_cache import BlockAllocator, PrefixCache
 
 CFG = ModelConfig(name="t", vocab_size=300, hidden_size=32,
@@ -79,26 +80,26 @@ def test_prefix_cache_lookup_longest_and_refcounts():
     pc = PrefixCache(a, max_entries=8)
     prompt = list(range(100, 118))                 # 18 tokens -> 4 full blocks
     blocks = a.alloc(len(prompt) + 1)
-    pc.register(prompt, blocks)
+    pc.register(prompt, blocks, tenant=TEN)
     assert len(pc) == 4                            # one entry per prefix length
     # Block i is held by its slot plus every entry covering it (lengths > i).
     assert a.ref_count(blocks[0]) == 1 + 4
     assert a.ref_count(blocks[3]) == 1 + 1
 
     # Identical prompt: all 4 full blocks reused.
-    shared, toks = pc.lookup(list(prompt))
+    shared, toks = pc.lookup(list(prompt), tenant=TEN)
     assert toks == 16 and shared == blocks[:4]
     assert a.ref_count(shared[0]) == 1 + 4 + 1
     a.free(shared)
 
     # Prompt diverging inside block 3: only 2 blocks reused.
     div = prompt[:10] + [9, 9, 9, 9, 9, 9, 9, 9]
-    shared, toks = pc.lookup(div)
+    shared, toks = pc.lookup(div, tenant=TEN)
     assert toks == 8 and shared == blocks[:2]
     a.free(shared)
 
     # Fully different prompt: miss.
-    shared, toks = pc.lookup([7] * 18)
+    shared, toks = pc.lookup([7] * 18, tenant=TEN)
     assert shared == [] and toks == 0
 
 
@@ -109,10 +110,29 @@ def test_prefix_cache_never_shares_whole_prompt():
     pc = PrefixCache(a)
     prompt = list(range(8))                        # exactly 2 blocks
     blocks = a.alloc(len(prompt) + 1)
-    pc.register(prompt, blocks)
-    shared, toks = pc.lookup(list(prompt))
+    pc.register(prompt, blocks, tenant=TEN)
+    shared, toks = pc.lookup(list(prompt), tenant=TEN)
     assert toks == 4 and len(shared) == 1          # only the first block
     a.free(shared)
+
+
+def test_prefix_cache_tenant_namespace_blocks_cross_tenant_hits():
+    """The same prompt registered by tenant A must be invisible to tenant
+    B — digests are seeded per tenant, so a cross-tenant lookup is a
+    structural miss, not a policy decision.  Resident-block accounting
+    attributes the entry to its owner."""
+    a = BlockAllocator(num_blocks=32, block_size=4)
+    pc = PrefixCache(a, max_entries=8)
+    prompt = list(range(100, 118))                 # 4 full blocks
+    blocks = a.alloc(len(prompt) + 1)
+    pc.register(prompt, blocks, tenant="team-a")
+    shared, toks = pc.lookup(list(prompt), tenant="team-b")
+    assert shared == [] and toks == 0              # structurally impossible
+    shared, toks = pc.lookup(list(prompt), tenant="team-a")
+    assert toks == 16 and shared == blocks[:4]
+    a.free(shared)
+    per = pc.blocks_by_tenant()
+    assert per.get("team-a", 0) > 0 and "team-b" not in per
 
 
 def test_eviction_with_live_follower_does_not_free_shared_pages():
@@ -123,16 +143,16 @@ def test_eviction_with_live_follower_does_not_free_shared_pages():
     pc = PrefixCache(a, max_entries=2)
     prompt = list(range(100, 109))                 # 9 tokens -> 2 full blocks
     blocks = a.alloc(10)
-    pc.register(prompt, blocks)
+    pc.register(prompt, blocks, tenant=TEN)
     a.free(blocks)                                 # slot done; cache holds on
 
-    shared, toks = pc.lookup(list(prompt))         # follower attaches
+    shared, toks = pc.lookup(list(prompt), tenant=TEN)  # follower attaches
     assert toks == 8 and len(shared) == 2
 
     # Displace the entry while the follower is still attached.
     p2 = [7] * 9
     b2 = a.alloc(10)
-    pc.register(p2, b2)
+    pc.register(p2, b2, tenant=TEN)
     a.free(b2)
     assert pc.evictions >= 1
 
@@ -154,7 +174,7 @@ def test_prefix_cache_eviction_returns_blocks():
     prompts = [[i] * 9 for i in range(3)]          # 2 full blocks each
     for p in prompts:
         blocks = a.alloc(10)
-        pc.register(p, blocks)
+        pc.register(p, blocks, tenant=TEN)
         a.free(blocks)                             # slot done; cache holds on
     assert len(pc) <= 4 and pc.evictions >= 1      # LRU entries displaced
     free0 = a.free_blocks

@@ -332,7 +332,7 @@ class _ScriptedReplica(Replica):
         return ReplicaStats(total_slots=4)
 
     def generate(self, prompt_ids, sampling=None, request_id=None,
-                 deadline_s=0.0, slo_class="standard"):
+                 deadline_s=0.0, slo_class="standard", tenant="public"):
         sampling = sampling or SamplingParams()
         h = RequestHandle(request_id or "r", eos_id=-1,
                           cancel_fn=lambda rid: self.cancelled.append(rid))
