@@ -8,9 +8,10 @@ SHELL := /bin/bash    # tier1 uses pipefail/PIPESTATUS
 .PHONY: run run-agent run-scheduler demo test test-fast tier1 tier1-mesh \
         chaos chaos-lifecycle chaos-fleet chaos-overload chaos-kvtier \
         chaos-trace chaos-signals chaos-elastic chaos-tenant \
+        chaos-remediate \
         diagnose-e2e bench bench-decode \
         bench-fleet bench-mesh bench-signals bench-elastic bench-prefill \
-        bench-tenant \
+        bench-tenant bench-remediate \
         dryrun smoke \
         preflight \
         deploy-agent docker \
@@ -128,6 +129,17 @@ chaos-tenant:
 	$(TEST_ENV) K8SLLM_LOCKCHECK=1 \
 	  $(PY) -m pytest tests/test_tenancy.py -q -p no:cacheprovider
 
+# Closed-loop remediation acceptance (docs/remediation.md): plan-grammar
+# property fuzz (every constrained sample parses and names a live
+# target), executor gate units on a fake clock (dry-run-first ordering,
+# breaker trip, approval required, idempotent replay), and the
+# four-scenario chaos e2e — crash loop, OOM, stale scheduler, node
+# pressure: inject → detect → plan → execute → verified recovery — with
+# lock discipline checked.
+chaos-remediate:
+	$(TEST_ENV) K8SLLM_LOCKCHECK=1 \
+	  $(PY) -m pytest tests/test_remediation.py -q -p no:cacheprovider
+
 # Diagnosis acceptance (docs/diagnosis.md): grammar compiler units, the
 # constrained-sampling fuzz (every sample parses), and the synthetic
 # crash-loop burst → verdict e2e — with lock discipline checked.
@@ -185,6 +197,13 @@ bench-elastic:
 bench-tenant:
 	$(TEST_ENV) BENCH_TENANT_ONLY=1 BENCH_MODEL=tiny BENCH_QUANT=none \
 	  $(PY) bench.py | tee tenant-bench.json
+
+# Remediation smoke: inject→verified-recovery latency for each chaos
+# scenario on the template backend, plus constrained-vs-free plan decode
+# tok/s on a tiny CPU engine (asserts the < 10% overhead budget).
+bench-remediate:
+	$(TEST_ENV) BENCH_REMEDIATE_ONLY=1 BENCH_MODEL=tiny BENCH_QUANT=none \
+	  $(PY) bench.py | tee remediation-bench.json
 
 smoke:              # boot server + 20-check live API suite
 	$(TEST_ENV) bash scripts/smoke.sh

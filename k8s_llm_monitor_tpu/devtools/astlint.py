@@ -595,10 +595,79 @@ class TenantNamespaceRule(Rule):
                         "tag the spill with the owning tenant")
 
 
+class RawKubeWriteRule(Rule):
+    """Cluster mutations must flow through the sanctioned executors.
+
+    The remediation executor earns its safety claims structurally:
+    every write is dry-run-validated first, breaker-guarded, rate
+    limited, idempotency-keyed, and (for destructive verbs) approval
+    gated.  A mutation issued from anywhere else skips all of that —
+    one stray ``delete_pod()`` in a handler and the audit trail, the
+    replay protection, and the approval gate are fiction.  This rule
+    flags the two ways a write can escape:
+
+    * a call to one of the mutation verbs — ``scale_statefulset``,
+      ``rollout_restart``, ``cordon_node``, ``delete_pod`` — on any
+      receiver;
+    * a ``_request(...)`` call passing ``method=`` POST/PATCH/DELETE
+      (the raw kube REST write path).
+
+    Exempt: ``remediation/executor.py`` (the executor itself),
+    ``fleet/autoscaler.py`` (``KubeScaleExecutor``, the pre-existing
+    sanctioned scale path), and the backends that *implement* the
+    verbs (``monitor/kube_rest.py``, ``monitor/cluster.py``).  Test
+    files are skipped — they drive fakes, not clusters.
+    """
+
+    name = "raw-kube-write"
+    description = "kube mutation outside the sanctioned executors"
+
+    _VERBS = {"scale_statefulset", "rollout_restart", "cordon_node",
+              "delete_pod"}
+    _WRITE_METHODS = {"POST", "PATCH", "DELETE"}
+    _EXEMPT = ("remediation/executor.py", "fleet/autoscaler.py",
+               "monitor/kube_rest.py", "monitor/cluster.py")
+
+    def check(self, tree: ast.Module, path: str) -> Iterator[Finding]:
+        norm = path.replace("\\", "/")
+        if any(norm.endswith(e) for e in self._EXEMPT):
+            return  # the sanctioned executors / verb implementations
+        base = norm.rsplit("/", 1)[-1]
+        if base.startswith("test_") or "/tests/" in norm:
+            return  # tests drive FakeCluster directly by design
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            attr = node.func.attr
+            if attr in self._VERBS:
+                yield self.finding(
+                    path, node,
+                    f"'{attr}()' mutates the cluster outside "
+                    f"remediation.executor / KubeScaleExecutor — this "
+                    f"skips dry-run validation, breakers, rate limits "
+                    f"and the approval gate; route it through "
+                    f"RemediationEngine")
+            elif attr == "_request":
+                for kw in node.keywords:
+                    if kw.arg == "method" \
+                            and isinstance(kw.value, ast.Constant) \
+                            and str(kw.value.value).upper() \
+                            in self._WRITE_METHODS:
+                        yield self.finding(
+                            path, node,
+                            f"raw kube {kw.value.value} via _request() "
+                            f"bypasses every remediation guard; add a "
+                            f"verb to KubeRestBackend and call it from "
+                            f"the executor instead")
+                        break
+
+
 def default_rules() -> list[Rule]:
     return [JitHostReadRule(), LockBlockingCallRule(), BareExceptRule(),
             MutableDefaultRule(), FaultPointRule(), RawLockRule(),
-            UnconstrainedParseRule(), TenantNamespaceRule()]
+            UnconstrainedParseRule(), TenantNamespaceRule(),
+            RawKubeWriteRule()]
 
 
 ALL_RULE_NAMES = tuple(r.name for r in default_rules())

@@ -114,7 +114,8 @@ def _route_matches(doc: str, registered: set[str]) -> bool:
 def extract_server_routes(src: str) -> dict[tuple[str, str], int]:
     """(method, normalized path) -> line, from the monitor server's
     ``_ROUTES`` dict and the ``startswith`` prefix routes in
-    ``_dispatch`` (GET-only by construction)."""
+    ``_dispatch``.  A prefix route's method comes from its inline
+    ``if method != "X": ...405...`` guard; GET when unguarded."""
     tree = ast.parse(src)
     out: dict[tuple[str, str], int] = {}
     for node in ast.walk(tree):
@@ -136,14 +137,28 @@ def extract_server_routes(src: str) -> dict[tuple[str, str], int]:
         elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
                 and node.name == "_dispatch":
             for sub in ast.walk(node):
-                if isinstance(sub, ast.Call) \
-                        and isinstance(sub.func, ast.Attribute) \
-                        and sub.func.attr == "startswith" \
-                        and sub.args \
-                        and isinstance(sub.args[0], ast.Constant) \
-                        and str(sub.args[0].value).startswith("/"):
-                    prefix = str(sub.args[0].value).rstrip("/")
-                    out[("GET", f"{prefix}/*")] = sub.lineno
+                if not (isinstance(sub, ast.If)
+                        and isinstance(sub.test, ast.Call)
+                        and isinstance(sub.test.func, ast.Attribute)
+                        and sub.test.func.attr == "startswith"
+                        and sub.test.args
+                        and isinstance(sub.test.args[0], ast.Constant)
+                        and str(sub.test.args[0].value).startswith("/")):
+                    continue
+                prefix = str(sub.test.args[0].value).rstrip("/")
+                method = "GET"
+                for guard in sub.body:
+                    if isinstance(guard, ast.If) \
+                            and isinstance(guard.test, ast.Compare) \
+                            and isinstance(guard.test.left, ast.Name) \
+                            and guard.test.left.id == "method" \
+                            and len(guard.test.ops) == 1 \
+                            and isinstance(guard.test.ops[0], ast.NotEq) \
+                            and isinstance(guard.test.comparators[0],
+                                           ast.Constant):
+                        method = str(guard.test.comparators[0].value)
+                        break
+                out[(method, f"{prefix}/*")] = sub.lineno
     return out
 
 

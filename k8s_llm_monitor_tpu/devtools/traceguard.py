@@ -54,9 +54,13 @@ FORBIDDEN_PRIMITIVES = frozenset({
 #: paged-prefill kernel (prefill_path="flash", interpreter on CPU) so the
 #: prefill/chunk/verify programs run the tiled online-softmax kernel and
 #: are held to the same zero-recompile / donation-rebinding / no-callback
-#: gates as the dense programs.
+#: gates as the dense programs; "grammar_swap" is the gather engine with a
+#: mid-run ``set_grammar`` swap to a *different* same-shape FSM between
+#: the warm and repeat passes — the remediation planner swaps per-request
+#: plan grammars at runtime, and this path proves the swap is a pure
+#: runtime-argument change (zero recompiles) rather than a retrace.
 DEFAULT_PATHS = ("gather", "fused", "mesh", "quant", "overlap",
-                 "flash_prefill")
+                 "flash_prefill", "grammar_swap")
 
 
 def force_cpu() -> None:
@@ -99,21 +103,27 @@ def _tiny_cfg(fused: bool, mesh_tp: int = 0):
                        num_kv_heads=2, dtype="float32", rope_theta=10_000.0)
 
 
-def _toy_fsm():
+def _toy_fsm(variant: int = 0):
     """A hand-built 2-state cycling grammar over a 16-token vocab: states
     1 and 2 allow tokens 3..10 and alternate forever (max_len unbounded, so
     constrained drives terminate by budget — eos_id -1 matches the guard
     engines).  Big enough to exercise every constrained program; far
     smaller than the 259-vocab verdict grammar, which would not fit the
-    tiny guard models."""
+    tiny guard models.
+
+    ``variant=1`` allows a shifted token window (5..12) in the SAME table
+    shape — the grammar_swap path installs it mid-run to prove that
+    swapping FSM *content* (the remediation planner does this per
+    snapshot) never retraces, only rebinding the runtime table argument."""
     import numpy as np
 
     from k8s_llm_monitor_tpu.diagnosis.grammar import TokenFSM
 
+    lo, hi = (5, 13) if variant else (3, 11)
     trans = np.full((3, 16), -1, dtype=np.int32)
     trans[0, :] = 0
-    trans[1, 3:11] = 2
-    trans[2, 3:11] = 1
+    trans[1, lo:hi] = 2
+    trans[2, lo:hi] = 1
     return TokenFSM.from_table(trans, start=1,
                                accept=np.array([False, True, True]),
                                eos_id=-1)
@@ -174,6 +184,12 @@ def build_engine(decode_path: str = "gather", seed: int = 0):
         cfg = _tiny_cfg(fused=False)
         impl = None
         kv_dtype = "int8"
+    elif decode_path == "grammar_swap":
+        # Same engine as "gather"; check_path swaps same-shape grammars
+        # between (and inside) the passes.  The FSM table is a runtime
+        # argument keyed only by shape, so the swap must not retrace.
+        cfg = _tiny_cfg(fused=False)
+        impl = select_decode_impl(cfg=cfg, mode="gather")
     else:
         cfg = _tiny_cfg(fused=decode_path == "fused")
         impl = select_decode_impl(cfg=cfg, mode=decode_path)
@@ -409,6 +425,7 @@ def _drive(engine, prompt_len: int, greedy: bool, tag: int,
 
 def check_path(decode_path: str) -> PathReport:
     engine = build_engine(decode_path)
+    swap = decode_path == "grammar_swap"
 
     # prompt_len 40 > the top bucket (32): forces the chunk-round admission
     # path, so the chunk-prefill programs (plain + FSM) are compiled in the
@@ -422,9 +439,17 @@ def check_path(decode_path: str) -> PathReport:
         _drive(engine, prompt_len=40, greedy=False, tag=8, constrained=True)
 
     def repeat():
+        # The grammar_swap path installs a different same-shape FSM before
+        # each constrained drive (and swaps back once mid-pass): the swap
+        # rebinds the runtime table argument, so the compile-count gate
+        # below must still read zero.
+        if swap:
+            engine.set_grammar(_toy_fsm(variant=1))
         _drive(engine, prompt_len=12, greedy=True, tag=3)
         _drive(engine, prompt_len=12, greedy=False, tag=4)
         _drive(engine, prompt_len=12, greedy=False, tag=6, constrained=True)
+        if swap:
+            engine.set_grammar(_toy_fsm(variant=0))
         _drive(engine, prompt_len=40, greedy=True, tag=9)
         _drive(engine, prompt_len=40, greedy=False, tag=10, constrained=True)
 

@@ -284,6 +284,14 @@ def _number_ast() -> _Node:
 
 
 def _schema_ast(schema: dict[str, Any]) -> _Node:
+    if "anyOf" in schema:
+        # Tagged-union schemas (the action-plan grammar: one object shape
+        # per verb).  Each arm must itself be a supported schema; the
+        # alternation stays a bounded regular language because every arm is.
+        arms = schema["anyOf"]
+        if not isinstance(arms, list) or not arms:
+            raise GrammarError("anyOf must be a non-empty list of schemas")
+        return _alt(*[_schema_ast(arm) for arm in arms])
     if "enum" in schema:
         values = schema["enum"]
         if not values or not all(isinstance(v, str) for v in values):
@@ -295,6 +303,16 @@ def _schema_ast(schema: dict[str, Any]) -> _Node:
     if stype == "number":
         return _number_ast()
     if stype == "integer":
+        if "minimum" in schema or "maximum" in schema:
+            # Bounded integer range as a literal alternation — small ranges
+            # only (replica counts, retry budgets), where enumerating keeps
+            # the DFA tiny and the admitted set exact.
+            lo = int(schema.get("minimum", 0))
+            hi = int(schema.get("maximum", lo))
+            if hi < lo or hi - lo > 256:
+                raise GrammarError(
+                    f"integer range [{lo},{hi}] unsupported (span > 256)")
+            return _alt(*[_Lit(str(i)) for i in range(lo, hi + 1)])
         return _seq(
             _alt(_Lit("-"), _Empty()),
             _alt(_Lit("0"), _seq(_Class(_DIGITS19), _rep(_Class(_DIGITS), 0, 8))),
@@ -473,19 +491,25 @@ def verdict_fsm(*, eos_id: int = _EOS_ID,
     return fsm
 
 
-def parse_verdict(text: str, dfa: CharDFA | None = None) -> dict[str, Any]:
-    """Validate ``text`` against the grammar, then parse.
+def parse_with_dfa(text: str, dfa: CharDFA) -> dict[str, Any]:
+    """Validate ``text`` against a compiled grammar, then parse.
 
     The single sanctioned ``json.loads`` of model output in the tree: the
     char DFA runs first, so anything the constrained sampler could not have
-    produced raises ``GrammarError`` instead of reaching the parser.
+    produced raises ``GrammarError`` instead of reaching the parser.  Every
+    schema family funnels through here (``parse_verdict`` for verdicts,
+    ``remediation.plans.parse_plan`` for action plans).
     """
     text = text.strip()
-    dfa = dfa or verdict_dfa()
     if not dfa.matches(text):
         raise GrammarError(
-            f"model output rejected by the verdict grammar: {text[:120]!r}")
+            f"model output rejected by the grammar: {text[:120]!r}")
     return json.loads(text)
+
+
+def parse_verdict(text: str, dfa: CharDFA | None = None) -> dict[str, Any]:
+    """Validate ``text`` against the verdict grammar, then parse."""
+    return parse_with_dfa(text, dfa or verdict_dfa())
 
 
 def render_verdict(severity: str, component: str, root_cause: str,

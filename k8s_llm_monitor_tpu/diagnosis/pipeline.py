@@ -260,6 +260,10 @@ class DiagnosisPipeline:
         )
         self.store = VerdictStore(capacity=self.cfg.history)
         self.handler = DiagnosisEventHandler(self)
+        # Plan stage (remediation/executor.py RemediationEngine), wired by
+        # build_server behind RemediationConfig; None leaves the pipeline
+        # verdict-only.
+        self.remediation: Any = None
         self.triggers_total = 0
         self.queries_total = 0
         self.errors_total = 0
@@ -367,6 +371,13 @@ class DiagnosisPipeline:
         logger.info("diagnosis published: severity=%s component=%s "
                     "lag=%.0fms", verdict.get("severity"),
                     verdict.get("component"), lag_ms)
+        # Plan stage: verdict → grammar-bounded action plan (and, when
+        # configured, gated execution + verification).  After publish —
+        # a failing plan stage must never cost the verdict, and
+        # on_verdict itself never raises.
+        if self.remediation is not None:
+            self.remediation.on_verdict(
+                verdict, trigger=", ".join(uniq), context=context)
 
     def run_pending(self) -> int:
         """Drain queued triggers synchronously (tests / no-thread mode).

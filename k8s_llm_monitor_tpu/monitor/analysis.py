@@ -121,6 +121,22 @@ class LLMBackend:
             severity, "cluster", text,
             "see root_cause; re-run the diagnosis after remediation", 0.3)
 
+    #: True only for backends that can decode under an arbitrary token FSM
+    #: (``LocalEngineBackend`` with the byte tokenizer).  Callers check it
+    #: before compiling a grammar nobody will use.
+    supports_grammar = False
+
+    def generate_with_grammar(self, prompt: str, fsm,
+                              temperature: float = 0.0,
+                              slo_class: str = "standard",
+                              tenant: str = "") -> str:
+        """Decode under a caller-supplied ``TokenFSM`` (the remediation
+        plan grammar).  Backends without token-level masking return ""
+        so callers fall back to their deterministic renderers — remote
+        endpoints cannot apply per-step logit masks, and free text run
+        through an arbitrary grammar would almost never parse."""
+        return ""
+
 
 class TemplateBackend(LLMBackend):
     """Deterministic diagnosis text from the prompt's evidence sections.
@@ -253,6 +269,13 @@ class LocalEngineBackend(LLMBackend):
         # under concurrent generate() threads.
         self._ema_ms_constrained: float | None = None
         self._ema_ms_free: float | None = None
+        # Serializes generate_with_grammar()'s set-grammar/decode/restore
+        # window against itself.  The diagnosis pipeline worker is the
+        # only constrained caller in-process, so a swap never races an
+        # in-flight constrained decode.
+        from k8s_llm_monitor_tpu.devtools.lockcheck import make_lock
+
+        self._grammar_swap_lock = make_lock("analysis.grammar_swap")
         if dev_weights:
             # Random-init weights + byte tokenizer produce byte soup; make
             # that loud in every API response's `model` field instead of
@@ -525,6 +548,59 @@ class LocalEngineBackend(LLMBackend):
         res = handle.result(timeout=self.GENERATION_TIMEOUT_S)
         if res.finish_reason == "error":
             raise RuntimeError(f"constrained generation failed: {res.error}")
+        self._note_decode_ms(True, len(res.token_ids),
+                             res.latency_s, res.ttft_s)
+        return self.tokenizer.decode(res.token_ids).strip()
+
+    @property
+    def supports_grammar(self) -> bool:
+        """Grammar swaps need an engine that already passed the verdict
+        -grammar install gates (byte tokenizer, vocab ≥ 259)."""
+        try:
+            return getattr(self.engine, "_grammar", None) is not None
+        except Exception:  # noqa: BLE001 — supervisor mid-rebuild
+            return False
+
+    def generate_with_grammar(self, prompt: str, fsm,
+                              temperature: float = 0.0,
+                              slo_class: str = "standard",
+                              tenant: str = "") -> str:
+        """Constrained decode under a caller-supplied FSM (the remediation
+        plan grammar): save the installed verdict grammar, swap in the
+        plan FSM, decode, restore.  Plan FSMs are padded to one fixed
+        table shape (``plans.PLAN_STATE_CAP``), and the engine treats the
+        table as a runtime argument — so the swap is recompile-free after
+        the first plan decode warms its shape (traceguard ``grammar_swap``
+        path proves it)."""
+        from k8s_llm_monitor_tpu.serving.engine import SamplingParams
+
+        with self._grammar_swap_lock:
+            try:
+                engine = self.engine
+            except Exception:  # noqa: BLE001 — supervisor mid-rebuild
+                return ""
+            saved = getattr(engine, "_grammar", None)
+            if saved is None:
+                return ""  # verdict install already refused this engine
+            try:
+                engine.set_grammar(fsm)
+            except ValueError as exc:
+                logger.warning("plan grammar rejected by engine: %s", exc)
+                return ""
+            try:
+                handle = self._submit(
+                    self.tokenizer.encode(prompt),
+                    # max_tokens=1 is a floor: submit() raises it to the
+                    # plan grammar's max accepting path.
+                    SamplingParams(max_tokens=1, temperature=temperature,
+                                   constrained=True),
+                    slo_class=slo_class, tenant=tenant,
+                )
+                res = handle.result(timeout=self.GENERATION_TIMEOUT_S)
+            finally:
+                engine.set_grammar(saved)
+        if res.finish_reason == "error":
+            raise RuntimeError(f"plan generation failed: {res.error}")
         self._note_decode_ms(True, len(res.token_ids),
                              res.latency_s, res.ttft_s)
         return self.tokenizer.decode(res.token_ids).strip()

@@ -267,6 +267,7 @@ class FakeCluster(ClusterBackend):
         self._nodes: dict[str, dict] = {}
         self._pods: dict[tuple[str, str], dict] = {}  # (ns, name)
         self._services: dict[tuple[str, str], dict] = {}
+        self._statefulsets: dict[tuple[str, str], dict] = {}
         self._events: dict[str, list[dict]] = {}  # ns -> list
         self._netpols: dict[tuple[str, str], dict] = {}
         self._logs: dict[tuple[str, str], list[str]] = {}
@@ -441,11 +442,115 @@ class FakeCluster(ClusterBackend):
         self._notify(("pods", namespace), "MODIFIED", snapshot)
         return snapshot
 
-    def delete_pod(self, namespace: str, name: str) -> None:
+    def delete_pod(self, namespace: str, name: str,
+                   dry_run: bool = False) -> None:
+        self._maybe_fail("delete_pod")
         with self._lock:
-            pod = self._pods.pop((namespace, name), None)
-        if pod is not None:
-            self._notify(("pods", namespace), "DELETED", pod)
+            if (namespace, name) not in self._pods:
+                raise NotFound(f"pod {namespace}/{name} not found")
+            if dry_run:
+                return
+            pod = self._pods.pop((namespace, name))
+        self._notify(("pods", namespace), "DELETED", pod)
+
+    # -- remediation verbs ---------------------------------------------------
+    # Mutations mirror the KubeRestBackend surface (dry_run maps to the
+    # server-side ``dryRun=All`` semantics: full validation, no state
+    # change) and honor ``fail_next`` so executor breaker paths are
+    # testable without a real API server.
+
+    def add_statefulset(
+        self,
+        name: str,
+        namespace: str = "default",
+        replicas: int = 1,
+        labels: dict[str, str] | None = None,
+    ) -> dict:
+        sts = {
+            "metadata": {
+                "name": name,
+                "namespace": namespace,
+                "uid": f"sts-{next(self._uid)}",
+                "labels": dict(labels or {}),
+                "creationTimestamp": rfc3339(utcnow()),
+            },
+            "spec": {"replicas": int(replicas)},
+            "status": {"readyReplicas": int(replicas)},
+        }
+        with self._lock:
+            self._statefulsets[(namespace, name)] = sts
+        return sts
+
+    def list_statefulsets(self, namespace: str) -> list[dict[str, Any]]:
+        self._maybe_fail("list_statefulsets")
+        with self._lock:
+            return [
+                copy.deepcopy(s)
+                for (ns, _), s in sorted(self._statefulsets.items())
+                if ns == namespace
+            ]
+
+    def get_statefulset_scale(self, namespace: str, name: str) -> int:
+        self._maybe_fail("get_statefulset_scale")
+        with self._lock:
+            try:
+                sts = self._statefulsets[(namespace, name)]
+            except KeyError:
+                raise NotFound(f"statefulset {namespace}/{name} not found")
+            return int(sts["spec"].get("replicas", 0))
+
+    def scale_statefulset(self, namespace: str, name: str, replicas: int,
+                          dry_run: bool = False) -> None:
+        self._maybe_fail("scale_statefulset")
+        with self._lock:
+            if (namespace, name) not in self._statefulsets:
+                raise NotFound(f"statefulset {namespace}/{name} not found")
+            if dry_run:
+                return
+            sts = self._statefulsets[(namespace, name)]
+            sts["spec"]["replicas"] = int(replicas)
+            sts["status"]["readyReplicas"] = int(replicas)
+
+    def rollout_restart(self, namespace: str, name: str,
+                        dry_run: bool = False) -> int:
+        """Restart the workload's pods: every pod whose name starts with
+        ``name`` returns to a fresh Running state (phase reset, restart
+        counters zeroed) — the fake-cluster equivalent of the rollout
+        replacing crashed pods with healthy ones.  Returns the pod count.
+        """
+        self._maybe_fail("rollout_restart")
+        with self._lock:
+            matched = [
+                (ns, pn) for (ns, pn) in self._pods
+                if ns == namespace and pn.startswith(name)
+            ]
+            if not matched:
+                raise NotFound(
+                    f"workload {namespace}/{name} matches no pods")
+            if dry_run:
+                return len(matched)
+            snapshots = []
+            for key in matched:
+                pod = self._pods[key]
+                pod["status"]["phase"] = "Running"
+                pod["status"]["startTime"] = rfc3339(utcnow())
+                for st in pod["status"].get("containerStatuses", []):
+                    st["ready"] = True
+                    st["restartCount"] = 0
+                    st["state"] = {"running": {"startedAt": rfc3339(utcnow())}}
+                snapshots.append(copy.deepcopy(pod))
+        for snap in snapshots:
+            self._notify(("pods", namespace), "MODIFIED", snap)
+        return len(snapshots)
+
+    def cordon_node(self, name: str, dry_run: bool = False) -> None:
+        self._maybe_fail("cordon_node")
+        with self._lock:
+            if name not in self._nodes:
+                raise NotFound(f"node {name} not found")
+            if dry_run:
+                return
+            self._nodes[name].setdefault("spec", {})["unschedulable"] = True
 
     def add_service(
         self,

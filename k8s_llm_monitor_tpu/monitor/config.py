@@ -51,6 +51,7 @@ ENV_KEYS: dict[str, str] = {
     "K8SLLM_LOCKCHECK_HOLD_MS": "runtime:devtools/lockcheck.py",
     "K8SLLM_TENANT_ENFORCE": "runtime:resilience/tenancy.py",
     "K8SLLM_TENANT_DEFAULT": "runtime:resilience/tenancy.py",
+    "K8SLLM_REMEDIATE_APPROVE": "runtime:remediation/executor.py",
 }
 
 
@@ -353,6 +354,44 @@ class AutoscaleConfig:
 
 
 @dataclass
+class RemediationConfig:
+    """Closed-loop remediation (remediation/executor.py): the diagnosis
+    pipeline's plan stage plus the gated executor and verification turn.
+    New; no reference equivalent — the Go reference stopped at verdicts."""
+
+    # Plan stage on/off.  Enabled by default: plans are cheap, grammar
+    # -bounded, and observe-only until `execute` (or a per-plan approval)
+    # says otherwise.
+    enabled: bool = True
+    # The big switch: False (default) stores plans without touching the
+    # cluster; an explicit POST /api/v1/remediations/<id>/approve still
+    # executes that one plan.  True executes non-destructive plans
+    # automatically (destructive verbs additionally need the approval
+    # gate — K8SLLM_REMEDIATE_APPROVE=1 or per-plan approval).
+    execute: bool = False
+    # Every mutation is validated with a dry-run call first (server-side
+    # dryRun=All on the real client, simulated validation on the fake).
+    dry_run_first: bool = True
+    # Post-action verification turn (session-pinned diagnosis + per-verb
+    # state predicate) and its capped escalation ladder.
+    verify: bool = True
+    max_retries: int = 2
+    # Per-verb circuit breaker around the cluster backend.
+    breaker_failures: int = 3
+    breaker_cooldown_s: float = 30.0
+    # Rate limits: minimum seconds between executions of the same verb,
+    # and of the same (verb, target) pair.
+    verb_interval_s: float = 5.0
+    target_interval_s: float = 60.0
+    # Idempotency: an identical (verb, target, trigger) execution within
+    # this window is refused as a replay (supervisor replays, double
+    # approvals).
+    replay_window_s: float = 300.0
+    # Stored-record ring size for GET /api/v1/remediations.
+    history: int = 128
+
+
+@dataclass
 class TenancyConfig:
     """Multi-tenant admission quotas + KV fairness (resilience/tenancy.py).
     New; no reference equivalent — the Go reference had no admission layer
@@ -404,6 +443,8 @@ class Config:
     fleet: FleetConfig = field(default_factory=FleetConfig)
     telemetry: TelemetryConfig = field(default_factory=TelemetryConfig)
     autoscale: AutoscaleConfig = field(default_factory=AutoscaleConfig)
+    remediation: RemediationConfig = field(
+        default_factory=RemediationConfig)
     tenancy: TenancyConfig = field(default_factory=TenancyConfig)
     logging: LoggingConfig = field(default_factory=LoggingConfig)
 

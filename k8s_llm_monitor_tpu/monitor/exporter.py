@@ -634,6 +634,40 @@ def _autoscaler_metrics(w: _Writer, ctl) -> None:
              [("", 1 if ctl.breaker.state == "open" else 0)])
 
 
+def _remediation_metrics(w: _Writer, rem) -> None:
+    """Closed-loop remediation accounting: every plan outcome (including
+    every refusing gate), per-verb breaker state, and verification
+    results — the observe-only default still counts ``proposed``."""
+    from k8s_llm_monitor_tpu.remediation.executor import (
+        OUTCOMES,
+        VERIFY_RESULTS,
+    )
+    from k8s_llm_monitor_tpu.remediation.plans import PLAN_VERBS
+
+    c = rem.counters()
+    plans = dict(c["plans_total"])
+    for verb in PLAN_VERBS:
+        for outcome in OUTCOMES:
+            plans.setdefault((verb, outcome), 0)
+    w.metric("remediation_plans_total", "counter",
+             "Action plans by verb and outcome (proposed, executed, error, "
+             "or the refusing gate: approval/breaker/rate/replay)",
+             [(f'{{verb="{v}",outcome="{o}"}}', n)
+              for (v, o), n in sorted(plans.items())])
+    w.metric("remediation_breaker_open", "gauge",
+             "1 while the verb's executor circuit breaker is open "
+             "(plans refused, not retried)",
+             [(f'{{verb="{v}"}}', open_)
+              for v, open_ in sorted(c["breaker_open"].items())])
+    verify = dict(c["verify_total"])
+    for result in VERIFY_RESULTS:
+        verify.setdefault(result, 0)
+    w.metric("remediation_verify_total", "counter",
+             "Post-action verification turns by result (resolved = "
+             "condition cleared AND the verdict is non-critical)",
+             [(f'{{result="{r}"}}', n) for r, n in sorted(verify.items())])
+
+
 def _diagnosis_metrics(w: _Writer, pipeline, backend) -> None:
     """Standing diagnosis pipeline (PR 6): verdict counts by severity,
     trigger→verdict lag, and the constrained-decode tax on the engine."""
@@ -833,6 +867,9 @@ def render_prometheus(srv: "MonitorServer", openmetrics: bool = False) -> str:
     autoscaler = getattr(srv, "autoscaler", None)
     if autoscaler is not None:
         _autoscaler_metrics(w, autoscaler)
+    remediation = getattr(srv, "remediation", None)
+    if remediation is not None:
+        _remediation_metrics(w, remediation)
     if srv.manager is not None:
         _manager_metrics(w, srv.manager)
     backend = getattr(srv.analysis, "backend", None)

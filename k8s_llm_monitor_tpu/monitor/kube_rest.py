@@ -41,6 +41,7 @@ import time
 import urllib.error
 import urllib.parse
 import urllib.request
+from datetime import datetime, timezone
 from typing import Any, Iterator
 
 import yaml
@@ -521,6 +522,56 @@ class KubeRestBackend(ClusterBackend):
             method="PATCH",
             body={"spec": {"replicas": int(replicas)}},
             content_type="application/merge-patch+json")
+
+    def list_statefulsets(self, namespace: str) -> list[dict[str, Any]]:
+        return self._items(
+            f"/apis/apps/v1/namespaces/{namespace}/statefulsets")
+
+    # -- remediation verbs ----------------------------------------------
+    # Everything the remediation executor may do to a live cluster.  All
+    # writes support the server-side ``dryRun=All`` probe (full apiserver
+    # validation + admission, no persistence), which is how plans are
+    # validated before the real mutation.  PATCH/DELETE are idempotent,
+    # so they ride the normal retry budget like ``scale_statefulset``.
+
+    def rollout_restart(self, namespace: str, name: str,
+                        dry_run: bool = False) -> dict[str, Any]:
+        """The ``kubectl rollout restart`` idiom: merge-patch a
+        ``restartedAt`` pod-template annotation so the controller rolls
+        every pod.  Tries Deployment first, falls back to StatefulSet."""
+        params = {"dryRun": "All"} if dry_run else None
+        body = {"spec": {"template": {"metadata": {"annotations": {
+            "kubectl.kubernetes.io/restartedAt":
+                datetime.now(timezone.utc).isoformat(),
+        }}}}}
+        for kind in ("deployments", "statefulsets"):
+            try:
+                return self._request(
+                    f"/apis/apps/v1/namespaces/{namespace}/{kind}/{name}",
+                    params,
+                    method="PATCH",
+                    body=body,
+                    content_type="application/merge-patch+json")
+            except NotFound:
+                continue
+        raise NotFound(f"no deployment or statefulset {namespace}/{name}")
+
+    def cordon_node(self, name: str, dry_run: bool = False) -> dict[str, Any]:
+        params = {"dryRun": "All"} if dry_run else None
+        return self._request(
+            f"/api/v1/nodes/{name}",
+            params,
+            method="PATCH",
+            body={"spec": {"unschedulable": True}},
+            content_type="application/merge-patch+json")
+
+    def delete_pod(self, namespace: str, name: str,
+                   dry_run: bool = False) -> dict[str, Any]:
+        params = {"dryRun": "All"} if dry_run else None
+        return self._request(
+            f"/api/v1/namespaces/{namespace}/pods/{name}",
+            params,
+            method="DELETE")
 
     def pod_logs(self, namespace: str, name: str, tail_lines: int = 100) -> str:
         return self._request(

@@ -102,6 +102,12 @@ class MonitorServer:
         # fleet.autoscaler.AutoscaleController on router-role processes
         # with autoscale.enabled; wired by frontend.build_router_server.
         self.autoscaler = None
+        # remediation.executor.RemediationEngine: the diagnosis pipeline's
+        # plan stage, wired by build_server behind RemediationConfig.
+        # None in dev mode (no cluster backend) or remediation.enabled=
+        # false.  Read by /api/v1/remediations, /api/v1/stats, and the
+        # exporter's remediation_* families.
+        self.remediation = None
         # resilience.tenancy.TenantGovernor: per-tenant admission quotas.
         # Wired by build_server (single-replica: the backend's governor)
         # or build_router_server (fleet: the router's); None in dev mode
@@ -257,6 +263,8 @@ class MonitorServer:
             # charged (delivered) tokens, in-flight reservations, and the
             # remaining token quota (-1 = unlimited).
             snap["tenants"] = self.governor.snapshot()
+        if self.remediation is not None:
+            snap["remediation"] = self.remediation.snapshot()
         return snap
 
     # -- lifecycle -------------------------------------------------------------
@@ -318,6 +326,7 @@ _ROUTES: dict[tuple[str, str], str] = {
     ("POST", "/api/v1/analyze"): "h_analyze",
     ("POST", "/api/v1/query"): "h_query",
     ("GET", "/api/v1/diagnoses"): "h_diagnoses",
+    ("GET", "/api/v1/remediations"): "h_remediations",
     ("GET", "/api/v1/signals"): "h_signals",
     ("GET", "/api/v1/timeseries"): "h_timeseries",
     ("GET", "/api/v1/trace"): "h_trace_recent",
@@ -480,6 +489,11 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
                 if method != "GET":
                     return self._send_error_text("Method not allowed", 405)
                 return self.h_trace(path[len("/api/v1/trace/") :])
+            if path.startswith("/api/v1/remediations/"):
+                if method != "POST":
+                    return self._send_error_text("Method not allowed", 405)
+                return self.h_remediation_action(
+                    path[len("/api/v1/remediations/") :])
             if path in _ROUTE_PATHS:
                 # registered path, wrong method (ref per-handler checks)
                 return self._send_error_text("Method not allowed", 405)
@@ -817,6 +831,52 @@ def _make_handler(srv: MonitorServer) -> type[BaseHTTPRequestHandler]:
             return self._send_error_text(
                 "Diagnosis pipeline not available - running in development "
                 "mode", 503)
+
+        def h_remediations(self) -> None:
+            """Stored action plans from the remediation engine, newest
+            first, plus the outcome counters the exporter renders."""
+            rem = srv.remediation
+            if rem is None:
+                return self._send_error_text(
+                    "Remediation engine not available - running without a "
+                    "cluster backend or remediation.enabled=false", 503)
+            query = parse_qs(urlparse(self.path).query)
+            try:
+                limit = int((query.get("limit", ["0"])[0]) or 0)
+            except ValueError:
+                return self._send_error_text("limit must be an integer", 400)
+            self._send_json({
+                "status": "success",
+                "remediations": rem.records(limit),
+                "counters": rem.snapshot(),
+                "timestamp": _now(),
+            })
+
+        def h_remediation_action(self, rest: str) -> None:
+            """Per-plan approval path: ``<id>/approve`` executes the plan
+            (the operator saying "do it" — this clears the destructive-verb
+            gate for that one plan, even in observe-only mode);
+            ``<id>/reject`` parks it."""
+            rem = srv.remediation
+            if rem is None:
+                return self._send_error_text(
+                    "Remediation engine not available", 503)
+            rec_id, _, action = rest.partition("/")
+            if action not in ("approve", "reject") or not rec_id:
+                return self._send_error_text(
+                    "use /api/v1/remediations/<id>/approve or .../reject",
+                    404)
+            rec = (rem.approve(rec_id) if action == "approve"
+                   else rem.reject(rec_id))
+            if rec is None:
+                return self._send_error_text(
+                    f"remediation {rec_id} not found", 404)
+            self._send_json({
+                "status": "success",
+                "action": action,
+                "remediation": rec,
+                "timestamp": _now(),
+            })
 
         def h_signals(self) -> None:
             """Derived autoscaler/anomaly signals from the telemetry
@@ -1365,6 +1425,23 @@ def build_server(
     # template backends or tenancy.enabled=false) feeds /api/v1/stats
     # and the exporter's tenant_* families.
     srv.governor = getattr(llm_backend, "governor", None)
+    # Closed-loop remediation: the pipeline's plan stage.  Needs both a
+    # cluster backend (targets are enumerated from live state) and the
+    # diagnosis pipeline (verdicts are the input); observe-only unless
+    # config.remediation.execute or a per-plan approval says otherwise.
+    if (config.remediation.enabled and backend is not None
+            and diagnosis is not None):
+        from k8s_llm_monitor_tpu.remediation.executor import (
+            RemediationEngine,
+        )
+
+        remediation = RemediationEngine(
+            backend, analysis, config.remediation,
+            namespaces=tuple(config.k8s.watch_namespaces),
+            pipeline=diagnosis,
+        )
+        diagnosis.remediation = remediation
+        srv.remediation = remediation
     if signals is not None:
         signals.attach(srv)
         # Crash-edge dumps (flight recorder v2) carry the trailing

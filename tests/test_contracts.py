@@ -38,6 +38,10 @@ SERVER_SRC = dedent("""
         def _dispatch(self, method, path):
             if path.startswith("/api/v1/metrics/nodes/"):
                 return "h_node"
+            if path.startswith("/api/v1/remediations/"):
+                if method != "POST":
+                    return "err405"
+                return "h_remediation_action"
     """)
 
 AGENT_SRC = dedent("""
@@ -63,6 +67,7 @@ GOOD_ROUTE_DOCS = {
         - `POST /api/v1/query`
         - `GET /api/v1/metrics/cluster`
         - `GET /api/v1/metrics/nodes/{name}`
+        - `POST /api/v1/remediations/{id}/approve`
         - GET :9090/health
         - GET :9090/api/v1/state
         - POST :9090/api/v1/command/{arm,land}
@@ -85,6 +90,9 @@ def test_extract_server_routes_reads_annassign_table_and_prefixes():
     routes = extract_server_routes(SERVER_SRC)
     assert ("POST", "/api/v1/query") in routes
     assert ("GET", "/api/v1/metrics/nodes/*") in routes  # _dispatch prefix
+    # a prefix route's inline `method != "POST"` guard sets its method
+    assert ("POST", "/api/v1/remediations/*") in routes
+    assert ("GET", "/api/v1/remediations/*") not in routes
 
 
 def test_extract_agent_routes_reads_get_dict_and_post_commands():

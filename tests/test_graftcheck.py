@@ -437,6 +437,71 @@ def test_tenant_namespace_live_repo_clean_without_suppressions():
     assert offenders == [], [f.human() for f in offenders]
 
 
+# -- astlint: raw-kube-write -------------------------------------------------
+
+
+def test_raw_kube_write_flags_mutation_verbs():
+    src = """
+    def handler(backend):
+        backend.delete_pod("ns", "pod-1")
+        backend.cordon_node("node-a")
+        backend.rollout_restart("ns", "web")
+        backend.scale_statefulset("ns", "db", 3)
+        backend.list_pods("ns")  # read: clean
+    """
+    findings = lint(src, "raw-kube-write")
+    assert len(findings) == 4
+    assert all("sanctioned" in f.message or "guard" in f.message
+               or "RemediationEngine" in f.message for f in findings)
+
+
+def test_raw_kube_write_flags_raw_rest_writes():
+    src = """
+    def poke(self):
+        self._request("/api/v1/pods/x", None, method="DELETE")
+        self._request("/apis/apps/v1/d", None, method="PATCH", body=b"{}")
+        self._request("/api/v1/pods", None)           # GET: clean
+        self._request("/version", None, method="GET")  # read: clean
+    """
+    assert len(lint(src, "raw-kube-write")) == 2
+
+
+def test_raw_kube_write_exempts_executors_backends_and_tests():
+    src = textwrap.dedent("""
+    def act(backend):
+        backend.delete_pod("ns", "p")
+    """)
+    for path in ("k8s_llm_monitor_tpu/remediation/executor.py",
+                 "k8s_llm_monitor_tpu/fleet/autoscaler.py",
+                 "k8s_llm_monitor_tpu/monitor/kube_rest.py",
+                 "k8s_llm_monitor_tpu/monitor/cluster.py",
+                 "tests/test_remediation.py"):
+        findings = astlint.lint_source(src, path=path)
+        assert [f for f in findings if f.rule == "raw-kube-write"] == [], path
+    findings = astlint.lint_source(src, path="monitor/server.py")
+    assert [f for f in findings if f.rule == "raw-kube-write"]
+
+
+def test_raw_kube_write_live_repo_clean_without_suppressions():
+    """Satellite acceptance: every cluster mutation in the live tree flows
+    through the sanctioned executors, and none hides behind a suppression
+    comment."""
+    import pathlib
+
+    root = pathlib.Path(astlint.__file__).resolve().parents[2]
+    rule = astlint.RawKubeWriteRule()
+    offenders = []
+    for sub in ("k8s_llm_monitor_tpu", "tests", "bench.py"):
+        for p in astlint.iter_py_files(root / sub):
+            src = p.read_text(encoding="utf-8")
+            per_line, per_file = astlint._suppressions(src)
+            suppressed = per_file | set().union(*per_line.values(), set())
+            assert rule.name not in suppressed, \
+                f"{p}: {rule.name} suppression is not allowed"
+            offenders += astlint.lint_source(src, str(p), rules=[rule])
+    assert offenders == [], [f.human() for f in offenders]
+
+
 # -- astlint: suppressions + parse errors ------------------------------------
 
 
@@ -497,7 +562,8 @@ def test_cli_exit_codes(tmp_path, capsys):
 
 @pytest.mark.slow  # builds a real engine (~15s); tier-1 is within ~40s of
 # its timeout budget, so the trace gates run via `make lint-trace` + `make test`
-@pytest.mark.parametrize("decode_path", ["gather", "fused", "mesh", "quant"])
+@pytest.mark.parametrize("decode_path", ["gather", "fused", "mesh", "quant",
+                                         "grammar_swap"])
 def test_same_bucket_reinvocation_compiles_nothing(decode_path):
     """The acceptance gate: warm both prefill programs + the decode ladder,
     then rerun same-shaped requests with different content — the program

@@ -1,0 +1,707 @@
+"""Closed-loop remediation: plan grammar, executor gates, chaos e2e.
+
+Layers, mirroring k8s_llm_monitor_tpu/remediation/:
+
+  * plan grammar — snapshot enumeration, render→parse round-trips, the
+    fixed-shape FSM contract, and a fuzz proving the deterministic
+    planner's output always lands inside the grammar;
+  * engine fuzz (slow) — FSM-constrained samples on a real tiny engine
+    parse as valid plans, and swapping snapshot grammars mid-run
+    triggers zero recompiles;
+  * executor gates — dry-run-first ordering, approval, rate limits,
+    breaker trips, idempotent replay, verification + escalation, all on
+    injected fake clocks;
+  * chaos e2e — four scenarios (crash loop, OOM, stale scheduler, node
+    pressure) through a real MonitorServer: inject → detect → plan →
+    execute → verified recovery, plus the HTTP routes and /metrics
+    families.
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from k8s_llm_monitor_tpu.diagnosis.grammar import GrammarError
+from k8s_llm_monitor_tpu.diagnosis.session import SessionManager
+from k8s_llm_monitor_tpu.monitor.cluster import FakeCluster, seed_demo_cluster
+from k8s_llm_monitor_tpu.monitor.config import Config, RemediationConfig
+from k8s_llm_monitor_tpu.monitor.models import EventInfo
+from k8s_llm_monitor_tpu.monitor.server import build_server
+from k8s_llm_monitor_tpu.remediation import (
+    DESTRUCTIVE_VERBS, PLAN_STATE_CAP, PLAN_VERBS, RemediationEngine,
+    TargetSnapshot, parse_plan, plan_fsm, propose_plan, render_plan)
+from k8s_llm_monitor_tpu.remediation.plans import (
+    MAX_PODS, MAX_REPLICAS, workload_of)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def tick(self, dt: float) -> None:
+        self.t += dt
+
+
+def _cluster() -> FakeCluster:
+    fake = FakeCluster()
+    fake.add_node("node-a")
+    fake.add_node("node-b")
+    fake.add_pod("web-frontend-7d4b9c6f5-x2x1p", node="node-a")
+    fake.add_pod("api-backend-6f5d8b7c9-k3k2m", node="node-b")
+    fake.add_statefulset("engine-decode", replicas=2)
+    return fake
+
+
+class StubAnalysis:
+    """Just enough of AnalysisEngine for the executor machinery."""
+
+    class backend:
+        name = "stub-model"
+        supports_grammar = False
+
+    def __init__(self, severity: str = "warning"):
+        self.severity = severity
+        self.questions: list[str] = []
+
+    def diagnose(self, question, context=None, slo_class="standard",
+                 tenant=""):
+        self.questions.append(question)
+        return {"severity": self.severity, "component": "c",
+                "root_cause": "r", "recommendation": "f", "confidence": 0.5}
+
+
+class StubPipeline:
+    def __init__(self):
+        self.events: list[EventInfo] = []
+
+    def offer(self, event: EventInfo) -> None:
+        self.events.append(event)
+
+
+def _engine(backend, *, analysis=None, clock=None, pipeline=None,
+            **overrides) -> RemediationEngine:
+    kw = dict(enabled=True, execute=False, dry_run_first=True, verify=False,
+              verb_interval_s=0.0, target_interval_s=0.0)
+    kw.update(overrides)
+    return RemediationEngine(
+        backend, analysis or StubAnalysis(), RemediationConfig(**kw),
+        namespaces=("default",), pipeline=pipeline,
+        clock=clock or FakeClock())
+
+
+def _verdict(sev="warning"):
+    return {"severity": sev, "component": "c", "root_cause": "r",
+            "recommendation": "f", "confidence": 0.5}
+
+
+# -- plan grammar ------------------------------------------------------------
+
+
+def test_workload_of_strips_hash_segments():
+    assert workload_of("web-frontend-7d4b9c6f5-x2x1p") == "web-frontend"
+    assert workload_of("api-backend-6f5d8b7c9-k3k2m") == "api-backend"
+    assert workload_of("engine-decode-0") == "engine-decode-0"  # no hash
+    assert workload_of("solo") == "solo"
+
+
+def test_snapshot_from_backend_enumerates_all_kinds():
+    snap = TargetSnapshot.from_backend(_cluster(), ["default"])
+    assert "default/web-frontend-7d4b9c6f5-x2x1p" in snap.pods
+    assert "default/web-frontend" in snap.workloads
+    assert "default/api-backend" in snap.workloads
+    assert snap.nodes == ("node-a", "node-b")
+    assert snap.statefulsets == ("default/engine-decode",)
+    assert snap.statefulset_replicas["default/engine-decode"] == 2
+
+
+def test_snapshot_caps_keep_unhealthy_pods_first():
+    fake = _cluster()
+    for i in range(MAX_PODS + 10):
+        fake.add_pod(f"bulk-{i:03d}", node="node-a")
+    fake.add_pod("stuck-worker-1a2b3", phase="Pending", node="")
+    snap = TargetSnapshot.from_backend(fake, ["default"])
+    assert len(snap.pods) == MAX_PODS
+    assert "default/stuck-worker-1a2b3" in snap.pods  # incident survives cap
+
+
+def test_snapshot_degrades_per_kind_on_backend_faults():
+    fake = _cluster()
+    fake.fail_next("list_statefulsets")
+    snap = TargetSnapshot.from_backend(fake, ["default"])
+    assert snap.statefulsets == ()          # that arm drops out
+    assert snap.pods and snap.nodes         # others unaffected
+    plan = parse_plan(render_plan("noop", reason="nothing safe"), snap)
+    assert plan["verb"] == "noop"
+    with pytest.raises(GrammarError):       # scale arm gone with its targets
+        parse_plan(render_plan(
+            "scale", target="default/engine-decode", replicas=3,
+            reason="x"), snap)
+
+
+def test_render_parse_roundtrip_every_verb():
+    snap = TargetSnapshot.from_backend(_cluster(), ["default"])
+    cases = [
+        ("scale", "default/engine-decode", 3),
+        ("rollout_restart", "default/web-frontend", None),
+        ("cordon", "node-a", None),
+        ("delete_pod", "default/web-frontend-7d4b9c6f5-x2x1p", None),
+        ("noop", "", None),
+    ]
+    for verb, target, replicas in cases:
+        text = render_plan(verb, target=target, reason="because tests",
+                           replicas=replicas)
+        plan = parse_plan(text, snap)
+        assert plan["verb"] == verb
+        if verb == "cordon":
+            assert plan["namespace"] == "" and plan["name"] == target
+        elif verb != "noop":
+            assert f"{plan['namespace']}/{plan['name']}" == target
+        if verb == "scale":
+            assert plan["replicas"] == replicas
+
+
+def test_parse_rejects_ghosts_free_text_and_oversized_replicas():
+    snap = TargetSnapshot.from_backend(_cluster(), ["default"])
+    with pytest.raises(GrammarError):
+        parse_plan(render_plan("delete_pod", target="default/ghost-pod",
+                               reason="x"), snap)
+    with pytest.raises(GrammarError):
+        parse_plan("please restart the web frontend", snap)
+    with pytest.raises(GrammarError):   # grammar-level: 17 > MAX_REPLICAS
+        parse_plan('{"verb":"scale","target":"default/engine-decode",'
+                   f'"replicas":{MAX_REPLICAS + 1},"reason":"x"}}', snap)
+    with pytest.raises(GrammarError):   # verbs can't cross target kinds
+        parse_plan('{"verb":"cordon","target":"default/engine-decode",'
+                   '"reason":"x"}', snap)
+
+
+def test_plan_fsm_fixed_shape_and_cache():
+    snap_a = TargetSnapshot.from_backend(_cluster(), ["default"])
+    other = _cluster()
+    other.add_pod("extra-worker-9z8y7", node="node-b")
+    snap_b = TargetSnapshot.from_backend(other, ["default"])
+    fsm_a, fsm_b = plan_fsm(snap_a), plan_fsm(snap_b)
+    assert fsm_a.trans.shape == fsm_b.trans.shape \
+        == (PLAN_STATE_CAP + 1, 259)
+    assert plan_fsm(snap_a) is fsm_a        # cache hit on identical key
+    assert fsm_a is not fsm_b
+
+
+def test_empty_snapshot_admits_only_noop():
+    snap = TargetSnapshot()
+    assert parse_plan(render_plan("noop", reason="idle"), snap)["verb"] \
+        == "noop"
+    with pytest.raises(GrammarError):
+        parse_plan('{"verb":"delete_pod","target":"a/b","reason":"x"}', snap)
+
+
+def test_propose_plan_output_always_parses_fuzz():
+    """The grammar property for the deterministic planner: whatever junk
+    lands in the verdict/trigger text — unicode, quotes, oversized
+    strings — the rendered plan parses and names a live target."""
+    snap = TargetSnapshot.from_backend(_cluster(), ["default"])
+    words = ["oomkilling", "backoff", "failedscheduling", "pressure",
+             "web-frontend", "api-backend-6f5d8b7c9-k3k2m", "node-a",
+             "engine-decode", "overload", "queue", "weird-λ-unicode",
+             '"quotes" and \\backslashes\\', "x" * 300, "", "NotReady"]
+    rng = random.Random(20)
+    for _ in range(200):
+        trigger = " ".join(rng.sample(words, rng.randint(1, 5)))
+        verdict = {"severity": "critical",
+                   "component": rng.choice(words),
+                   "root_cause": rng.choice(words),
+                   "recommendation": rng.choice(words), "confidence": 0.5}
+        plan = parse_plan(propose_plan(snap, verdict, trigger), snap)
+        assert plan["verb"] in PLAN_VERBS
+        if plan["verb"] == "scale":
+            assert 0 <= plan["replicas"] <= MAX_REPLICAS
+
+
+def test_propose_plan_keyword_ladder():
+    snap = TargetSnapshot.from_backend(_cluster(), ["default"])
+    cases = [
+        ("FailedScheduling pod web-frontend-7d4b9c6f5-x2x1p stuck",
+         "delete_pod", "web-frontend-7d4b9c6f5-x2x1p"),
+        ("memory pressure on node-b", "cordon", "node-b"),
+        ("BackOff restarting web-frontend", "rollout_restart",
+         "web-frontend"),
+        ("queue depth high, scale up engine-decode", "scale",
+         "engine-decode"),
+        ("nothing recognizable here", "noop", ""),
+    ]
+    for trigger, verb, name in cases:
+        plan = parse_plan(propose_plan(snap, _verdict(), trigger), snap)
+        assert (plan["verb"], plan["name"]) == (verb, name), trigger
+    # scale proposes current+1 from the snapshot's observed replicas
+    plan = parse_plan(propose_plan(snap, _verdict(), "overload"), snap)
+    assert plan["replicas"] == 3
+
+
+# -- engine fuzz: constrained samples parse, swaps don't recompile -----------
+
+
+@pytest.fixture(scope="module")
+def plan_engine():
+    import jax
+
+    from k8s_llm_monitor_tpu.models import llama
+    from k8s_llm_monitor_tpu.models.config import ModelConfig
+    from k8s_llm_monitor_tpu.serving.engine import (
+        EngineConfig, InferenceEngine)
+    from k8s_llm_monitor_tpu.utils.tokenizer import ByteTokenizer
+
+    cfg = ModelConfig(name="tiny", vocab_size=300, hidden_size=32,
+                      intermediate_size=64, num_layers=2, num_heads=4,
+                      num_kv_heads=2, dtype="float32", rope_theta=1e4)
+    tok = ByteTokenizer()
+    params = llama.init_params(jax.random.PRNGKey(0), cfg)
+    engine = InferenceEngine(
+        cfg, params,
+        EngineConfig(max_slots=2, num_blocks=512, block_size=16,
+                     max_blocks_per_seq=128, prefill_buckets=(64, 128, 512),
+                     decode_steps_per_iter=4),
+        tokenizer=tok)
+    return engine, tok
+
+
+@pytest.mark.slow  # real-engine compile; `make chaos-remediate` runs these
+@pytest.mark.parametrize("temperature,top_k", [(0.0, 0), (0.9, 20), (1.5, 5)])
+def test_constrained_plan_samples_always_parse(plan_engine, temperature,
+                                               top_k):
+    """The 100%-schema-valid property for plans: whatever the sampler
+    draws under the snapshot FSM must parse and name a live target."""
+    from k8s_llm_monitor_tpu.serving.engine import SamplingParams
+
+    engine, tok = plan_engine
+    snap = TargetSnapshot.from_backend(_cluster(), ["default"])
+    engine.set_grammar(plan_fsm(snap, eos_id=tok.eos_id))
+    prompt = tok.encode("## Plan\nchoose one action:\n")
+    results = engine.generate(
+        [prompt, prompt],
+        SamplingParams(max_tokens=1, temperature=temperature, top_k=top_k,
+                       constrained=True))
+    for res in results:
+        assert res.finish_reason in ("eos", "stop", "length"), res
+        plan = parse_plan(tok.decode(res.token_ids), snap)
+        assert plan["verb"] in PLAN_VERBS
+        if plan["verb"] == "delete_pod":
+            assert f"{plan['namespace']}/{plan['name']}" in snap.pods
+
+
+@pytest.mark.slow  # shares the real-engine fixture above
+def test_snapshot_grammar_swap_is_recompile_free(plan_engine):
+    """The traceguard claim on real plan grammars: swapping one
+    snapshot's padded FSM for another's (different cluster, same fixed
+    shape) triggers zero new compiles after warm-up."""
+    from k8s_llm_monitor_tpu.devtools.traceguard import count_new_compiles
+    from k8s_llm_monitor_tpu.serving.engine import SamplingParams
+
+    engine, tok = plan_engine
+    snap_a = TargetSnapshot.from_backend(_cluster(), ["default"])
+    other = _cluster()
+    other.add_pod("drainer-4c5d6", node="node-b", phase="Pending")
+    other.add_statefulset("engine-prefill", replicas=1)
+    snap_b = TargetSnapshot.from_backend(other, ["default"])
+    prompt = tok.encode("## Plan\n")
+    sampling = SamplingParams(max_tokens=1, constrained=True)
+
+    engine.set_grammar(plan_fsm(snap_a, eos_id=tok.eos_id))
+    [warm] = engine.generate([prompt], sampling)   # warm the FSM programs
+    parse_plan(tok.decode(warm.token_ids), snap_a)
+
+    def swapped():
+        engine.set_grammar(plan_fsm(snap_b, eos_id=tok.eos_id))
+        [res] = engine.generate([prompt], sampling)
+        parse_plan(tok.decode(res.token_ids), snap_b)
+
+    new_compiles, _ = count_new_compiles(engine, swapped)
+    assert new_compiles == 0
+
+
+# -- executor gates ----------------------------------------------------------
+
+
+class RecordingBackend:
+    """Delegating wrapper logging every mutation verb with its dry_run."""
+
+    _VERBS = ("scale_statefulset", "rollout_restart", "cordon_node",
+              "delete_pod")
+
+    def __init__(self, inner):
+        self._inner = inner
+        self.calls: list[tuple[str, bool]] = []
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name in self._VERBS and callable(attr):
+            def wrapper(*args, **kwargs):
+                self.calls.append((name, bool(kwargs.get("dry_run", False))))
+                return attr(*args, **kwargs)
+            return wrapper
+        return attr
+
+
+def test_on_verdict_gates_severity_and_enabled():
+    eng = _engine(_cluster())
+    assert eng.on_verdict(_verdict("info"), trigger="BackOff web-frontend") \
+        is None
+    off = _engine(_cluster(), enabled=False)
+    assert off.on_verdict(_verdict("critical"), trigger="x") is None
+
+
+def test_observe_only_default_proposes_without_touching_cluster():
+    backend = RecordingBackend(_cluster())
+    eng = _engine(backend)                  # execute=False: observe-only
+    rec = eng.on_verdict(_verdict(), trigger="BackOff web-frontend crash")
+    assert rec["status"] == "proposed" and rec["outcome"] == "proposed"
+    assert rec["plan"]["verb"] == "rollout_restart"
+    assert rec["planner"] == "heuristic"    # stub backend: no grammar path
+    assert backend.calls == []              # nothing executed
+    assert eng.counters()["plans_total"][("rollout_restart", "proposed")] == 1
+
+
+def test_execute_is_dry_run_first():
+    backend = RecordingBackend(_cluster())
+    eng = _engine(backend, execute=True)
+    rec = eng.on_verdict(_verdict(), trigger="BackOff web-frontend crash")
+    assert rec["status"] == "executed"
+    assert rec["detail"] == "dry-run validated"
+    assert backend.calls == [("rollout_restart", True),
+                             ("rollout_restart", False)]
+
+
+def test_destructive_verbs_refuse_without_approval(monkeypatch):
+    monkeypatch.delenv("K8SLLM_REMEDIATE_APPROVE", raising=False)
+    fake = _cluster()
+    fake.add_pod("stuck-worker-1a2b3", phase="Pending", node="")
+    eng = _engine(fake, execute=True)
+    rec = eng.on_verdict(
+        _verdict(), trigger="FailedScheduling pod stuck-worker-1a2b3")
+    assert rec["plan"]["verb"] == "delete_pod"
+    assert rec["plan"]["verb"] in DESTRUCTIVE_VERBS
+    assert rec["status"] == "awaiting_approval"
+    assert rec["outcome"] == "refused_approval"
+    # env-wide operator approval opens the gate immediately
+    monkeypatch.setenv("K8SLLM_REMEDIATE_APPROVE", "1")
+    assert eng.execute(rec["id"]) == "executed"
+    assert all((p["metadata"] or {}).get("name") != "stuck-worker-1a2b3"
+               for p in fake.list_pods("default"))
+
+
+def test_per_plan_approve_executes_even_in_observe_only(monkeypatch):
+    monkeypatch.delenv("K8SLLM_REMEDIATE_APPROVE", raising=False)
+    fake = _cluster()
+    eng = _engine(fake)                     # observe-only
+    rec = eng.on_verdict(_verdict(), trigger="node-a memory pressure")
+    assert rec["plan"]["verb"] == "cordon" and rec["status"] == "proposed"
+    out = eng.approve(rec["id"])
+    assert out["approved"] and out["status"] == "executed"
+    node = next(n for n in fake.list_nodes()
+                if n["metadata"]["name"] == "node-a")
+    assert node["spec"]["unschedulable"] is True
+
+
+def test_reject_parks_the_record():
+    eng = _engine(_cluster())
+    rec = eng.on_verdict(_verdict(), trigger="BackOff web-frontend")
+    assert eng.reject(rec["id"])["status"] == "rejected"
+    assert eng.execute(rec["id"]) == "refused_replay"   # terminal state
+    assert eng.reject("rem-99999") is None
+
+
+def test_idempotent_replay_refused_within_window():
+    clk = FakeClock()
+    eng = _engine(_cluster(), execute=True, clock=clk,
+                  replay_window_s=300.0)
+    rec1 = eng.on_verdict(_verdict(), trigger="BackOff web-frontend")
+    assert rec1["status"] == "executed"
+    # supervisor replay: same verdict, same trigger → same idempotency key
+    rec2 = eng.on_verdict(_verdict(), trigger="BackOff web-frontend")
+    assert rec2["idempotency_key"] == rec1["idempotency_key"]
+    assert rec2["outcome"] == "refused_replay"
+    assert eng.execute(rec1["id"]) == "refused_replay"  # terminal record
+    clk.tick(301)                           # window expires
+    assert eng.execute(rec2["id"]) == "executed"
+
+
+def test_rate_limits_per_verb_and_per_target():
+    clk = FakeClock()
+    eng = _engine(_cluster(), clock=clk, verb_interval_s=5.0,
+                  target_interval_s=60.0)
+    rec_a = eng.on_verdict(_verdict(), trigger="BackOff web-frontend")
+    rec_b = eng.on_verdict(_verdict(), trigger="crash api-backend")
+    assert eng.execute(rec_a["id"]) == "executed"
+    assert eng.execute(rec_b["id"]) == "refused_rate"   # verb cooldown
+    clk.tick(6)
+    assert eng.execute(rec_b["id"]) == "executed"
+    clk.tick(6)                              # verb open, target still cold
+    rec_a2 = eng.on_verdict(_verdict(), trigger="BackOff web-frontend again")
+    assert eng.execute(rec_a2["id"]) == "refused_rate"
+    clk.tick(60)
+    assert eng.execute(rec_a2["id"]) == "executed"
+
+
+def test_breaker_trips_after_failures_and_cools_down():
+    clk = FakeClock()
+    fake = _cluster()
+    eng = _engine(fake, clock=clk, breaker_failures=2,
+                  breaker_cooldown_s=30.0)
+    rec = eng.on_verdict(_verdict(), trigger="BackOff web-frontend")
+    fake.fail_next("rollout_restart", 2)
+    assert eng.execute(rec["id"]) == "error"            # failure 1
+    assert eng.execute(rec["id"]) == "error"            # failure 2: opens
+    assert eng.execute(rec["id"]) == "refused_breaker"
+    assert eng.counters()["breaker_open"]["rollout_restart"] == 1
+    clk.tick(31)                             # cooldown: half-open probe
+    assert eng.execute(rec["id"]) == "executed"
+    assert eng.counters()["breaker_open"]["rollout_restart"] == 0
+
+
+def test_verify_resolved_marks_record_verified():
+    analysis = StubAnalysis(severity="warning")
+    analysis.sessions = SessionManager()
+    eng = _engine(_cluster(), analysis=analysis, execute=True, verify=True)
+    rec = eng.on_verdict(_verdict(), trigger="BackOff web-frontend")
+    assert rec["status"] == "verified"
+    assert rec["verify"]["result"] == "resolved"
+    assert rec["verify"]["condition_cleared"] is True
+    assert eng.counters()["verify_total"]["resolved"] == 1
+    # the verification turn ran on a session pinned to post-action context
+    session = analysis.sessions.get(f"remediation-{rec['id']}")
+    assert session is not None
+    assert "Cluster state (post-action)" in session.context
+    assert "Is the triggering condition cleared?" in analysis.questions[-1]
+
+
+def test_unresolved_escalates_then_parks(monkeypatch):
+    analysis = StubAnalysis(severity="critical")   # verdict never clears
+    pipeline = StubPipeline()
+    eng = _engine(_cluster(), analysis=analysis, execute=True, verify=True,
+                  pipeline=pipeline, max_retries=1)
+    rec = eng.on_verdict(_verdict(), trigger="BackOff web-frontend")
+    assert rec["status"] == "unresolved" and rec["escalation"] == 1
+    assert len(pipeline.events) == 1        # re-entered the pipeline
+    event = pipeline.events[0]
+    assert event.reason == "RemediationUnresolved:rollout_restart"
+    assert event.source == "remediation"
+    assert eng.verify(rec["id"]) == "unresolved"   # attempt 2 > max_retries
+    assert eng.get(rec["id"])["status"] == "escalated"
+    assert len(pipeline.events) == 1        # parked: no more re-entry
+
+
+def test_condition_cleared_predicates():
+    fake = _cluster()
+    eng = _engine(fake)
+    assert eng._condition_cleared({"verb": "noop", "namespace": "",
+                                   "name": "", "reason": ""})
+    fake.scale_statefulset("default", "engine-decode", 3)
+    assert eng._condition_cleared(
+        {"verb": "scale", "namespace": "default", "name": "engine-decode",
+         "replicas": 3, "reason": ""})
+    assert not eng._condition_cleared(
+        {"verb": "scale", "namespace": "default", "name": "engine-decode",
+         "replicas": 5, "reason": ""})
+    assert not eng._condition_cleared(
+        {"verb": "cordon", "namespace": "", "name": "node-b", "reason": ""})
+    fake.cordon_node("node-b")
+    assert eng._condition_cleared(
+        {"verb": "cordon", "namespace": "", "name": "node-b", "reason": ""})
+    assert not eng._condition_cleared(
+        {"verb": "delete_pod", "namespace": "default",
+         "name": "api-backend-6f5d8b7c9-k3k2m", "reason": ""})
+    fake.delete_pod("default", "api-backend-6f5d8b7c9-k3k2m")
+    assert eng._condition_cleared(
+        {"verb": "delete_pod", "namespace": "default",
+         "name": "api-backend-6f5d8b7c9-k3k2m", "reason": ""})
+
+
+def test_snapshot_and_counters_are_json_safe():
+    eng = _engine(_cluster(), execute=True)
+    eng.on_verdict(_verdict(), trigger="BackOff web-frontend")
+    snap = eng.snapshot()
+    json.dumps(snap)                        # must serialize for /api/v1/stats
+    assert snap["enabled"] and snap["execute"]
+    assert snap["plans_total"]["rollout_restart/executed"] == 1
+    assert snap["breakers"]["rollout_restart"] == "closed"
+    assert eng.records(limit=1)[0]["id"] == "rem-00001"
+
+
+# -- chaos e2e: four scenarios through a real server -------------------------
+
+
+@pytest.fixture(scope="module")
+def remediation_server():
+    cfg = Config()
+    cfg.llm.provider = "template"
+    cfg.diagnosis.burst_threshold = 3
+    cfg.diagnosis.window_s = 60.0
+    cfg.diagnosis.cooldown_s = 0.0
+    cfg.remediation.execute = True
+    cfg.remediation.verify = True
+    cfg.remediation.verb_interval_s = 0.0
+    cfg.remediation.target_interval_s = 0.0
+    backend = seed_demo_cluster(FakeCluster())
+    backend.add_statefulset("engine-decode", replicas=2)
+    srv = build_server(cfg, backend=backend)
+    srv.start()
+    yield srv, backend
+    srv.stop()
+
+
+def _get(srv, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}{path}", timeout=30) as r:
+        body = r.read().decode()
+        return (json.loads(body) if r.headers["Content-Type"].startswith(
+            "application/json") else body)
+
+
+def _post(srv, path, payload=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}",
+        data=json.dumps(payload or {}).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=60) as r:
+        return json.loads(r.read())
+
+
+def _drive_scenario(srv, reason, message, want_verb, want_name, want_status,
+                    timeout=20.0):
+    """Inject a warning burst and wait for a matching remediation record
+    (verb + target name, so earlier scenarios' records never match) to
+    reach ``want_status``."""
+    for i in range(4):
+        srv.diagnosis.handler.on_event(EventInfo(
+            type="Warning", reason=reason, message=f"{message} (try {i})",
+            source="chaos"))
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for rec in srv.remediation.records():
+            if rec["plan"]["verb"] == want_verb \
+                    and rec["plan"]["name"] == want_name \
+                    and rec["status"] == want_status:
+                return rec
+        time.sleep(0.05)
+    raise AssertionError(
+        f"no {want_verb}/{want_name} record reached {want_status}; have "
+        f"{[(r['plan']['verb'], r['plan']['name'], r['status']) for r in srv.remediation.records()]}")
+
+
+def test_chaos_crash_loop_verified_recovery(remediation_server):
+    """Scenario 1: crash loop → rollout_restart → pods Running again."""
+    srv, backend = remediation_server
+    backend.update_pod("default", "web-frontend-7d4b9c6f5-x2x1p",
+                       phase="CrashLoopBackOff")
+    rec = _drive_scenario(
+        srv, "BackOff",
+        "Back-off restarting failed container in web-frontend",
+        "rollout_restart", "web-frontend", "verified")
+    assert rec["outcome"] == "executed"
+    assert rec["detail"] == "dry-run validated"
+    assert rec["plan"]["name"] == "web-frontend"
+    assert rec["verify"]["result"] == "resolved"
+    assert rec["verify"]["condition_cleared"] is True
+    pod = next(p for p in backend.list_pods("default")
+               if p["metadata"]["name"].startswith("web-frontend"))
+    assert pod["status"]["phase"] == "Running"
+
+
+def test_chaos_oom_verified_recovery(remediation_server):
+    """Scenario 2: OOM kill → rollout_restart of the OOMing workload."""
+    srv, backend = remediation_server
+    backend.update_pod("default", "api-backend-6f5d8b7c9-k3k2m",
+                       phase="OOMKilled")
+    rec = _drive_scenario(
+        srv, "OOMKilling", "Memory cgroup out of memory: api-backend",
+        "rollout_restart", "api-backend", "verified")
+    assert rec["plan"]["name"] == "api-backend"
+    assert rec["verify"]["result"] == "resolved"
+    pod = next(p for p in backend.list_pods("default")
+               if p["metadata"]["name"].startswith("api-backend"))
+    assert pod["status"]["phase"] == "Running"
+
+
+def test_chaos_stale_scheduler_needs_approval(remediation_server,
+                                              monkeypatch):
+    """Scenario 3: stale scheduler → delete_pod, which must refuse until
+    the operator approves over HTTP — then executes and verifies."""
+    monkeypatch.delenv("K8SLLM_REMEDIATE_APPROVE", raising=False)
+    srv, backend = remediation_server
+    backend.add_pod("batch-runner-5f7d8", phase="Pending", node="")
+    rec = _drive_scenario(
+        srv, "FailedScheduling",
+        "pod batch-runner-5f7d8 unschedulable: stale scheduler assignment",
+        "delete_pod", "batch-runner-5f7d8", "awaiting_approval")
+    assert rec["outcome"] == "refused_approval"
+    assert [p for p in backend.list_pods("default")
+            if p["metadata"]["name"] == "batch-runner-5f7d8"]  # still there
+    resp = _post(srv, f"/api/v1/remediations/{rec['id']}/approve")
+    assert resp["action"] == "approve"
+    assert resp["remediation"]["status"] == "verified"
+    assert resp["remediation"]["verify"]["result"] == "resolved"
+    assert not [p for p in backend.list_pods("default")
+                if p["metadata"]["name"] == "batch-runner-5f7d8"]
+
+
+def test_chaos_node_pressure_env_approval(remediation_server, monkeypatch):
+    """Scenario 4: node memory pressure → cordon, gated until the blanket
+    env approval is set — the second approval path."""
+    srv, backend = remediation_server
+    monkeypatch.delenv("K8SLLM_REMEDIATE_APPROVE", raising=False)
+    rec = _drive_scenario(
+        srv, "NodeHasMemoryPressure",
+        "node k3d-demo-agent-1 under memory pressure, evicting",
+        "cordon", "k3d-demo-agent-1", "awaiting_approval")
+    monkeypatch.setenv("K8SLLM_REMEDIATE_APPROVE", "1")
+    assert srv.remediation.execute(rec["id"]) == "executed"
+    rec = srv.remediation.get(rec["id"])
+    assert rec["status"] == "verified"
+    assert rec["plan"]["name"] == "k3d-demo-agent-1"
+    node = next(n for n in backend.list_nodes()
+                if n["metadata"]["name"] == "k3d-demo-agent-1")
+    assert node["spec"]["unschedulable"] is True
+
+
+def test_remediations_api_and_metrics(remediation_server):
+    """Runs after the four scenarios: routes, limits, error edges, and
+    the three exporter families with their contractual labels."""
+    srv, _ = remediation_server
+    payload = _get(srv, "/api/v1/remediations")
+    assert payload["status"] == "success"
+    assert len(payload["remediations"]) >= 4
+    assert payload["counters"]["plans_total"]
+    assert len(_get(srv, "/api/v1/remediations?limit=1")["remediations"]) == 1
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _get(srv, "/api/v1/remediations?limit=abc")
+    assert err.value.code == 400
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _get(srv, "/api/v1/remediations/rem-00001/approve")   # GET: no
+    assert err.value.code == 405
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _post(srv, "/api/v1/remediations/rem-99999/approve")
+    assert err.value.code == 404
+    with pytest.raises(urllib.error.HTTPError) as err:
+        _post(srv, "/api/v1/remediations/rem-00001/frobnicate")
+    assert err.value.code == 404
+
+    metrics = _get(srv, "/metrics")
+    assert ('k8s_llm_monitor_remediation_plans_total{'
+            'verb="rollout_restart",outcome="executed"}') in metrics
+    assert ('k8s_llm_monitor_remediation_plans_total{'
+            'verb="delete_pod",outcome="refused_approval"}') in metrics
+    assert ('k8s_llm_monitor_remediation_breaker_open{verb="cordon"}'
+            in metrics)
+    assert ('k8s_llm_monitor_remediation_verify_total{result="resolved"}'
+            in metrics)
+
+    stats = _get(srv, "/api/v1/stats")
+    assert "remediation" in json.dumps(stats)
